@@ -1,0 +1,46 @@
+"""spark_rapids_tpu: a TPU-native columnar SQL/DataFrame acceleration framework.
+
+Re-designed from scratch for TPU (JAX/XLA/Pallas/pjit) with the capability
+envelope of the spark-rapids GPU accelerator (reference: firestarman/spark-rapids
+v0.3.0-SNAPSHOT): a columnar batch data model resident in HBM, a plan-rewrite
+planner with per-operator CPU fallback and explain/tagging machinery, an
+operator+expression library lowered to XLA, device-side partitioning and an
+ICI all-to-all shuffle, a tiered device->host->disk spill subsystem, and
+host-side Parquet/CSV decode staged asynchronously into device memory.
+
+Architectural mapping (reference -> TPU build):
+  cudf Table in GPU memory   -> ColumnBatch: struct of padded, static-shape
+                                jax.Arrays (data + validity + string offsets)
+  libcudf kernels (JNI)      -> jitted XLA computations, fused per pipeline
+                                stage; Pallas for hot ops
+  GpuOverrides plan rewrite  -> plan.overrides tagging/replacement over a
+                                logical plan built by the DataFrame frontend
+  RMM pool + spill tiers     -> mem.catalog device->host->disk spill chain
+  UCX shuffle transport      -> parallel.shuffle all-to-all over an ICI mesh
+                                (shard_map + XLA collectives)
+"""
+
+import jax as _jax
+
+# A SQL engine needs real 64-bit longs/doubles; XLA on TPU emulates int64
+# where needed.  Must run before any jnp array is materialized.
+_jax.config.update("jax_enable_x64", True)
+
+from spark_rapids_tpu.version import __version__
+from spark_rapids_tpu.config import RapidsConf, conf
+from spark_rapids_tpu import types
+
+__all__ = [
+    "__version__",
+    "RapidsConf",
+    "conf",
+    "types",
+]
+
+
+def __getattr__(name):
+    # Lazy to avoid importing the full planner stack on package import.
+    if name == "TpuSparkSession":
+        from spark_rapids_tpu.session import TpuSparkSession
+        return TpuSparkSession
+    raise AttributeError(name)

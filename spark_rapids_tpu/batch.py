@@ -1,0 +1,322 @@
+"""Device-resident columnar batch model.
+
+The TPU analogue of the reference's GpuColumnVector/ColumnarBatch layer
+(GpuColumnVector.java:39, SURVEY.md section 2.3).  A cudf ``Table`` in GPU
+memory becomes a :class:`ColumnBatch`: a struct of dense ``jax.Array`` buffers
+staged in HBM.
+
+TPU-first design decisions:
+
+* **Static shapes.**  XLA compiles one executable per shape, so every batch is
+  padded to a bucketed capacity (powers of two) and carries a dynamic
+  ``num_rows`` scalar.  Kernels mask out rows >= num_rows.  This replaces the
+  reference's dynamic cudf row counts and is the bucketed-padded-batch design
+  called out in SURVEY.md section 7.
+* **Pytree batches.**  ``ColumnBatch``/``DeviceColumn`` are registered pytrees
+  with (schema, capacity) as static treedef aux data, so whole batches flow
+  through ``jax.jit`` boundaries and fused pipeline stages without manual
+  packing.
+* **Validity masks, not sentinels.**  Every column has a bool validity array;
+  NULL semantics live in the expression kernels.
+* **Strings** use the cudf layout: ``offsets`` int32[cap+1] into a flat
+  ``uint8`` byte buffer (itself bucketed), so most string ops become
+  gather/scan ops which XLA handles well.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+
+MIN_CAPACITY = 8
+
+
+def round_up_capacity(n: int, minimum: int = MIN_CAPACITY) -> int:
+    """Bucketed capacity: next power of two >= n (>= minimum).
+
+    Powers of two bound the number of distinct compiled shapes per schema to
+    log2(max_rows) — the recompilation-economics lever from SURVEY.md section 7.
+    """
+    cap = max(int(minimum), 1)
+    n = max(int(n), 1)
+    while cap < n:
+        cap <<= 1
+    return cap
+
+
+# --------------------------------------------------------------------------
+# Host-side column/batch: numpy representation used by IO, the CPU oracle and
+# host<->HBM staging (the HostMemoryBuffer analogue).
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class HostColumn:
+    dtype: T.DataType
+    values: np.ndarray  # object ndarray of str|None for strings
+    validity: np.ndarray  # bool, True = valid
+
+    def __post_init__(self):
+        self.values = np.asarray(self.values)
+        self.validity = np.asarray(self.validity, dtype=np.bool_)
+        assert len(self.values) == len(self.validity)
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    @staticmethod
+    def from_list(dtype: T.DataType, items: Sequence[Any]) -> "HostColumn":
+        validity = np.array([x is not None for x in items], dtype=np.bool_)
+        if dtype.is_string:
+            values = np.array([x if x is not None else "" for x in items], dtype=object)
+        else:
+            values = np.array(
+                [x if x is not None else 0 for x in items], dtype=dtype.np_dtype
+            )
+        return HostColumn(dtype, values, validity)
+
+    def to_list(self) -> List[Any]:
+        out: List[Any] = []
+        for v, ok in zip(self.values, self.validity):
+            if not ok:
+                out.append(None)
+            elif self.dtype.is_string:
+                out.append(str(v))
+            elif self.dtype == T.BOOLEAN:
+                out.append(bool(v))
+            elif self.dtype.is_fractional:
+                out.append(float(v))
+            else:
+                out.append(int(v))
+        return out
+
+
+class HostBatch:
+    """A host (numpy) table; the staging representation between IO and device."""
+
+    def __init__(self, schema: T.Schema, columns: Sequence[HostColumn]):
+        self.schema = schema
+        self.columns = list(columns)
+        nrows = {len(c) for c in self.columns}
+        assert len(nrows) <= 1, f"ragged batch: {nrows}"
+        self.num_rows = len(self.columns[0]) if self.columns else 0
+
+    @staticmethod
+    def from_pydict(data: Dict[str, Tuple[T.DataType, Sequence[Any]]]) -> "HostBatch":
+        fields, cols = [], []
+        for name, (dtype, items) in data.items():
+            fields.append(T.Field(name, dtype))
+            cols.append(HostColumn.from_list(dtype, items))
+        return HostBatch(T.Schema(fields), cols)
+
+    def to_pydict(self) -> Dict[str, List[Any]]:
+        return {
+            f.name: c.to_list() for f, c in zip(self.schema.fields, self.columns)
+        }
+
+    def column(self, name: str) -> HostColumn:
+        return self.columns[self.schema.index_of(name)]
+
+    def slice(self, start: int, length: int) -> "HostBatch":
+        cols = [
+            HostColumn(c.dtype, c.values[start : start + length],
+                       c.validity[start : start + length])
+            for c in self.columns
+        ]
+        return HostBatch(self.schema, cols)
+
+    @staticmethod
+    def concat(batches: Sequence["HostBatch"]) -> "HostBatch":
+        assert batches
+        schema = batches[0].schema
+        cols = []
+        for i, f in enumerate(schema.fields):
+            values = np.concatenate([b.columns[i].values for b in batches])
+            validity = np.concatenate([b.columns[i].validity for b in batches])
+            cols.append(HostColumn(f.dtype, values, validity))
+        return HostBatch(schema, cols)
+
+    def __repr__(self):
+        return f"HostBatch({self.schema}, rows={self.num_rows})"
+
+
+# --------------------------------------------------------------------------
+# Device column
+# --------------------------------------------------------------------------
+
+
+class DeviceColumn:
+    """One column staged in HBM: data buffer + validity mask (+ offsets)."""
+
+    def __init__(self, dtype: T.DataType, data, validity, offsets=None):
+        self.dtype = dtype
+        self.data = data
+        self.validity = validity
+        self.offsets = offsets  # strings only: int32[cap+1]
+
+    @property
+    def is_string(self) -> bool:
+        return self.dtype.is_string
+
+    def tree_flatten(self):
+        if self.offsets is None:
+            return (self.data, self.validity), (self.dtype, False)
+        return (self.data, self.validity, self.offsets), (self.dtype, True)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        dtype, has_offsets = aux
+        if has_offsets:
+            data, validity, offsets = children
+            return cls(dtype, data, validity, offsets)
+        data, validity = children
+        return cls(dtype, data, validity, None)
+
+    def __repr__(self):
+        shape = getattr(self.data, "shape", None)
+        return f"DeviceColumn({self.dtype}, data={shape})"
+
+
+jax.tree_util.register_pytree_node(
+    DeviceColumn, DeviceColumn.tree_flatten, DeviceColumn.tree_unflatten
+)
+
+
+class ColumnBatch:
+    """A device table: columns + dynamic valid-row count + static capacity."""
+
+    def __init__(self, schema: T.Schema, columns: Sequence[DeviceColumn], num_rows,
+                 capacity: int):
+        self.schema = schema
+        self.columns = tuple(columns)
+        self.num_rows = num_rows  # int32 scalar (device array inside jit)
+        self.capacity = int(capacity)
+
+    def column(self, name: str) -> DeviceColumn:
+        return self.columns[self.schema.index_of(name)]
+
+    @property
+    def row_mask(self):
+        """bool[cap]: True for rows < num_rows (the live rows)."""
+        return jnp.arange(self.capacity, dtype=jnp.int32) < self.num_rows
+
+    def with_columns(self, schema: T.Schema, columns: Sequence[DeviceColumn]
+                     ) -> "ColumnBatch":
+        return ColumnBatch(schema, columns, self.num_rows, self.capacity)
+
+    def tree_flatten(self):
+        return (tuple(self.columns), self.num_rows), (self.schema, self.capacity)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        schema, capacity = aux
+        columns, num_rows = children
+        return cls(schema, columns, num_rows, capacity)
+
+    def __repr__(self):
+        return f"ColumnBatch({self.schema}, cap={self.capacity})"
+
+    def host_num_rows(self) -> int:
+        return int(jax.device_get(self.num_rows))
+
+
+jax.tree_util.register_pytree_node(
+    ColumnBatch, ColumnBatch.tree_flatten, ColumnBatch.tree_unflatten
+)
+
+
+# --------------------------------------------------------------------------
+# Host <-> device staging (the H2D/D2H copy layer; reference: GpuColumnVector
+# host builders + copy, GpuColumnVector.java:41-130)
+# --------------------------------------------------------------------------
+
+
+def _string_host_to_buffers(values: np.ndarray, validity: np.ndarray,
+                            byte_capacity: Optional[int] = None
+                            ) -> Tuple[np.ndarray, np.ndarray]:
+    """Encode an object array of strings to (offsets int32[n+1], bytes uint8)."""
+    encoded = [
+        (v if isinstance(v, bytes) else str(v).encode("utf-8")) if ok else b""
+        for v, ok in zip(values, validity)
+    ]
+    lengths = np.fromiter((len(e) for e in encoded), dtype=np.int64,
+                          count=len(encoded))
+    offsets = np.zeros(len(encoded) + 1, dtype=np.int32)
+    np.cumsum(lengths, out=offsets[1:])
+    total = int(offsets[-1])
+    cap = byte_capacity if byte_capacity is not None else round_up_capacity(
+        max(total, 1), minimum=16)
+    data = np.zeros(cap, dtype=np.uint8)
+    if total:
+        data[:total] = np.frombuffer(b"".join(encoded), dtype=np.uint8)
+    return offsets, data
+
+
+def host_column_to_device(col: HostColumn, capacity: int,
+                          device=None) -> DeviceColumn:
+    n = len(col)
+    assert capacity >= n
+    validity = np.zeros(capacity, dtype=np.bool_)
+    validity[:n] = col.validity
+    put = (lambda x: jax.device_put(x, device)) if device is not None else jax.device_put
+    if col.dtype.is_string:
+        offsets, data = _string_host_to_buffers(col.values, col.validity)
+        full_offsets = np.full(capacity + 1, offsets[-1], dtype=np.int32)
+        full_offsets[: n + 1] = offsets
+        return DeviceColumn(col.dtype, put(data), put(validity), put(full_offsets))
+    data = np.zeros(capacity, dtype=col.dtype.np_dtype)
+    data[:n] = col.values
+    return DeviceColumn(col.dtype, put(data), put(validity), None)
+
+
+def host_to_device(batch: HostBatch, capacity: Optional[int] = None,
+                   device=None) -> ColumnBatch:
+    cap = capacity if capacity is not None else round_up_capacity(batch.num_rows)
+    cols = [host_column_to_device(c, cap, device) for c in batch.columns]
+    num_rows = jnp.asarray(batch.num_rows, dtype=jnp.int32)
+    if device is not None:
+        num_rows = jax.device_put(num_rows, device)
+    return ColumnBatch(batch.schema, cols, num_rows, cap)
+
+
+def device_to_host(batch: ColumnBatch) -> HostBatch:
+    n = batch.host_num_rows()
+    out_cols = []
+    for f, c in zip(batch.schema.fields, batch.columns):
+        validity = np.asarray(jax.device_get(c.validity))[:n]
+        if f.dtype.is_string:
+            offsets = np.asarray(jax.device_get(c.offsets))
+            data = np.asarray(jax.device_get(c.data))
+            values = np.empty(n, dtype=object)
+            for i in range(n):
+                values[i] = bytes(data[offsets[i]:offsets[i + 1]]).decode(
+                    "utf-8", errors="replace")
+            out_cols.append(HostColumn(f.dtype, values, validity))
+        else:
+            data = np.asarray(jax.device_get(c.data))[:n]
+            out_cols.append(HostColumn(f.dtype, data, validity))
+    return HostBatch(batch.schema, out_cols)
+
+
+def empty_device_batch(schema: T.Schema, capacity: int = MIN_CAPACITY) -> ColumnBatch:
+    cols = []
+    for f in schema.fields:
+        validity = jnp.zeros(capacity, dtype=jnp.bool_)
+        if f.dtype.is_string:
+            cols.append(DeviceColumn(
+                f.dtype,
+                jnp.zeros(16, dtype=jnp.uint8),
+                validity,
+                jnp.zeros(capacity + 1, dtype=jnp.int32),
+            ))
+        else:
+            cols.append(DeviceColumn(
+                f.dtype, jnp.zeros(capacity, dtype=f.dtype.jnp_dtype), validity, None
+            ))
+    return ColumnBatch(schema, cols, jnp.asarray(0, dtype=jnp.int32), capacity)

@@ -1,0 +1,269 @@
+"""Typed configuration registry.
+
+TPU-native analogue of the reference's RapidsConf (RapidsConf.scala:116-256):
+a registry of typed ConfEntry objects with defaults and doc strings, plus
+markdown doc generation (RapidsConf.scala:717,814 generates docs/configs.md).
+Per-operator enable keys (``spark.rapids.sql.exec.<Name>`` etc.,
+GpuOverrides.scala:129-137) are registered dynamically by the planner rules.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, Generic, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+_REGISTRY: "Dict[str, ConfEntry]" = {}
+_REGISTRY_LOCK = threading.Lock()
+
+
+class ConfEntry(Generic[T]):
+    def __init__(self, key: str, default: T, doc: str, converter: Callable[[str], T],
+                 internal: bool = False):
+        self.key = key
+        self.default = default
+        self.doc = doc
+        self.converter = converter
+        self.internal = internal
+
+    def get(self, conf: "RapidsConf") -> T:
+        return conf.get(self.key)
+
+    def __repr__(self):
+        return f"ConfEntry({self.key}={self.default!r})"
+
+
+def _to_bool(s: str) -> bool:
+    return str(s).strip().lower() in ("true", "1", "yes", "on")
+
+
+def _register(entry: ConfEntry) -> ConfEntry:
+    with _REGISTRY_LOCK:
+        if entry.key in _REGISTRY:
+            return _REGISTRY[entry.key]
+        _REGISTRY[entry.key] = entry
+    return entry
+
+
+def conf_bool(key: str, default: bool, doc: str, internal: bool = False) -> ConfEntry:
+    return _register(ConfEntry(key, default, doc, _to_bool, internal))
+
+
+def conf_int(key: str, default: int, doc: str, internal: bool = False) -> ConfEntry:
+    return _register(ConfEntry(key, default, doc, int, internal))
+
+
+def conf_float(key: str, default: float, doc: str, internal: bool = False) -> ConfEntry:
+    return _register(ConfEntry(key, default, doc, float, internal))
+
+
+def conf_str(key: str, default: str, doc: str, internal: bool = False) -> ConfEntry:
+    return _register(ConfEntry(key, default, doc, str, internal))
+
+
+def conf_bytes(key: str, default: int, doc: str, internal: bool = False) -> ConfEntry:
+    def parse(s: str) -> int:
+        s = str(s).strip().lower()
+        mult = 1
+        for suffix, m in (("k", 1 << 10), ("m", 1 << 20), ("g", 1 << 30), ("t", 1 << 40)):
+            if s.endswith(suffix + "b"):
+                s, mult = s[:-2], m
+                break
+            if s.endswith(suffix):
+                s, mult = s[:-1], m
+                break
+        return int(float(s) * mult)
+    return _register(ConfEntry(key, default, doc, parse, internal))
+
+
+class RapidsConf:
+    """An immutable-ish snapshot of configuration values.
+
+    Values resolve in order: explicit settings > environment variables
+    (``SPARK_RAPIDS_TPU_<KEY_WITH_UNDERSCORES>``) > registered default.
+    """
+
+    def __init__(self, settings: Optional[Dict[str, Any]] = None):
+        self._settings: Dict[str, Any] = dict(settings or {})
+
+    def set(self, key: str, value: Any) -> "RapidsConf":
+        self._settings[key] = value
+        return self
+
+    def get(self, key: str, default: Any = None) -> Any:
+        entry = _REGISTRY.get(key)
+        if key in self._settings:
+            raw = self._settings[key]
+            if entry is not None and isinstance(raw, str):
+                return entry.converter(raw)
+            return raw
+        env_key = "SPARK_RAPIDS_TPU_" + key.replace(".", "_").upper()
+        if env_key in os.environ:
+            raw = os.environ[env_key]
+            return entry.converter(raw) if entry is not None else raw
+        if entry is not None:
+            return entry.default
+        return default
+
+    def copy(self, **overrides: Any) -> "RapidsConf":
+        c = RapidsConf(dict(self._settings))
+        for k, v in overrides.items():
+            c.set(k, v)
+        return c
+
+    def is_operator_enabled(self, key: str, default: bool = True) -> bool:
+        v = self.get(key)
+        if v is None:
+            return default
+        return v if isinstance(v, bool) else _to_bool(v)
+
+    # ---- core entries (mirroring RapidsConf.scala:271-700) ----
+
+    @property
+    def sql_enabled(self) -> bool:
+        return SQL_ENABLED.get(self)
+
+    @property
+    def explain(self) -> str:
+        return EXPLAIN.get(self)
+
+    @property
+    def batch_size_bytes(self) -> int:
+        return BATCH_SIZE_BYTES.get(self)
+
+    @property
+    def max_readers_batch_size_rows(self) -> int:
+        return READER_BATCH_SIZE_ROWS.get(self)
+
+    @property
+    def concurrent_tpu_tasks(self) -> int:
+        return CONCURRENT_TPU_TASKS.get(self)
+
+    @property
+    def test_enforce_tpu(self) -> bool:
+        return TEST_ENFORCE_TPU.get(self)
+
+    @property
+    def allow_incompat(self) -> bool:
+        return INCOMPATIBLE_OPS.get(self)
+
+    @property
+    def has_nans(self) -> bool:
+        return HAS_NANS.get(self)
+
+    @property
+    def variable_float_agg(self) -> bool:
+        return VARIABLE_FLOAT_AGG.get(self)
+
+    @property
+    def host_spill_storage_size(self) -> int:
+        return HOST_SPILL_STORAGE_SIZE.get(self)
+
+    @property
+    def replace_sort_merge_join(self) -> bool:
+        return REPLACE_SORT_MERGE_JOIN.get(self)
+
+
+SQL_ENABLED = conf_bool(
+    "spark.rapids.sql.enabled", True,
+    "Enable (true) or disable (false) TPU acceleration of SQL operators.")
+EXPLAIN = conf_str(
+    "spark.rapids.sql.explain", "NONE",
+    "Explain why parts of a query were or were not placed on the TPU. "
+    "Options: NONE, ALL, NOT_ON_TPU.")
+BATCH_SIZE_BYTES = conf_bytes(
+    "spark.rapids.sql.batchSizeBytes", 512 * 1024 * 1024,
+    "The target size in bytes of columnar batches processed on the TPU. "
+    "The coalesce layer concatenates smaller batches up to this goal.")
+READER_BATCH_SIZE_ROWS = conf_int(
+    "spark.rapids.sql.reader.batchSizeRows", 1 << 20,
+    "Soft cap on the number of rows the file readers put in one batch.")
+CONCURRENT_TPU_TASKS = conf_int(
+    "spark.rapids.sql.concurrentTpuTasks", 1,
+    "Number of tasks that can execute concurrently on a single TPU chip. "
+    "Tasks above the limit block in the TpuSemaphore.")
+TEST_ENFORCE_TPU = conf_bool(
+    "spark.rapids.sql.test.enabled", False,
+    "Testing only: fail query planning if any supported operator would "
+    "fall back to the CPU.", internal=True)
+INCOMPATIBLE_OPS = conf_bool(
+    "spark.rapids.sql.incompatibleOps.enabled", False,
+    "Enable operators that produce results that differ in corner cases "
+    "from Spark CPU semantics.")
+HAS_NANS = conf_bool(
+    "spark.rapids.sql.hasNans", True,
+    "Whether float/double data is assumed to possibly contain NaNs; when "
+    "true some float aggregations and joins stay on CPU for exactness.")
+VARIABLE_FLOAT_AGG = conf_bool(
+    "spark.rapids.sql.variableFloatAgg.enabled", False,
+    "Allow float/double aggregations whose result can vary run-to-run "
+    "because of non-deterministic reduction order.")
+HOST_SPILL_STORAGE_SIZE = conf_bytes(
+    "spark.rapids.memory.host.spillStorageSize", 1 << 30,
+    "Bytes of host memory used to cache spilled device data before "
+    "overflowing to disk.")
+DEVICE_POOL_FRACTION = conf_float(
+    "spark.rapids.memory.tpu.allocFraction", 0.9,
+    "Fraction of usable HBM to reserve for the device buffer pool at startup.")
+REPLACE_SORT_MERGE_JOIN = conf_bool(
+    "spark.rapids.sql.replaceSortMergeJoin.enabled", True,
+    "Replace sort-merge joins with TPU hash joins and drop the now "
+    "unneeded sorts (reference: RapidsConf.scala:423).")
+SHUFFLE_PARTITIONS = conf_int(
+    "spark.sql.shuffle.partitions", 8,
+    "Number of partitions used for shuffle exchanges.")
+SHUFFLE_COMPRESSION_CODEC = conf_str(
+    "spark.rapids.shuffle.compression.codec", "copy",
+    "Codec for compressing shuffled table buffers (copy = passthrough).")
+STRING_HASH_JOIN = conf_bool(
+    "spark.rapids.sql.stringHashGroupJoin.enabled", True,
+    "Group by / join on string keys via 64-bit hashes computed on device; "
+    "collisions are astronomically unlikely but theoretically possible.")
+ENABLE_ICI_SHUFFLE = conf_bool(
+    "spark.rapids.shuffle.ici.enabled", True,
+    "Use the ICI all-to-all collective shuffle when a multi-chip mesh is "
+    "available; otherwise fall back to the host exchange.")
+PINNED_POOL_SIZE = conf_bytes(
+    "spark.rapids.memory.pinnedPool.size", 0,
+    "Size of the pinned host staging pool used by the native runtime for "
+    "host<->HBM transfers (0 = disabled).")
+CPU_RANGE_PARTITIONING_SAMPLE = conf_int(
+    "spark.rapids.sql.rangePartitioning.sampleSize", 1 << 16,
+    "Rows sampled per partition when computing range-partitioning bounds.")
+MULTITHREADED_READ_THREADS = conf_int(
+    "spark.rapids.sql.format.parquet.multiThreadedRead.numThreads", 8,
+    "Threads used to read+decode file footers and column chunks in "
+    "parallel ahead of device staging.")
+PARQUET_ENABLED = conf_bool(
+    "spark.rapids.sql.format.parquet.enabled", True,
+    "Enable TPU-accelerated parquet scans.")
+CSV_ENABLED = conf_bool(
+    "spark.rapids.sql.format.csv.enabled", True,
+    "Enable TPU-accelerated CSV scans.")
+UDF_COMPILER_ENABLED = conf_bool(
+    "spark.rapids.sql.udfCompiler.enabled", False,
+    "Compile python row UDFs into columnar expressions when possible.")
+
+
+def registry() -> List[ConfEntry]:
+    return sorted(_REGISTRY.values(), key=lambda e: e.key)
+
+
+def generate_docs() -> str:
+    """Markdown doc generation (analogue of RapidsConf.main -> docs/configs.md)."""
+    lines = [
+        "# spark_rapids_tpu configuration",
+        "",
+        "| Key | Default | Description |",
+        "|---|---|---|",
+    ]
+    for e in registry():
+        if not e.internal:
+            lines.append(f"| `{e.key}` | {e.default} | {e.doc} |")
+    return "\n".join(lines) + "\n"
+
+
+#: Process-wide active configuration (sessions may carry their own copies).
+conf = RapidsConf()

@@ -1,0 +1,46 @@
+"""Expression library: declarative AST + TPU (jax) and CPU (numpy) evaluation.
+
+Analogue of the reference expression library (~35 files under
+sql-plugin/.../rapids, SURVEY.md section 2.5).  Every expression implements
+``tpu_eval`` (traced under jit, static shapes, validity-mask null semantics)
+and ``cpu_eval`` (numpy; Spark-CPU-semantics oracle used for fallback and
+tests, mirroring the reference's CPU-vs-GPU compare strategy in
+SparkQueryCompareTestSuite.scala:153-161).
+"""
+
+from spark_rapids_tpu.exprs.base import (
+    Expression, DevVal, CpuVal, ColumnRef, BoundRef, Literal, Alias, SortOrder,
+    bind_references, resolve,
+)
+from spark_rapids_tpu.exprs.arithmetic import (
+    Add, Subtract, Multiply, Divide, IntegralDivide, Remainder, UnaryMinus, Abs, Pmod,
+)
+from spark_rapids_tpu.exprs.predicates import (
+    Equals, NotEquals, LessThan, LessThanOrEqual, GreaterThan, GreaterThanOrEqual,
+    EqualNullSafe, And, Or, Not, In,
+)
+from spark_rapids_tpu.exprs.nullexprs import (
+    IsNull, IsNotNull, IsNan, Coalesce, NaNvl,
+)
+from spark_rapids_tpu.exprs.conditional import If, CaseWhen
+from spark_rapids_tpu.exprs.cast import Cast
+from spark_rapids_tpu.exprs.mathexprs import (
+    Sqrt, Exp, Log, Pow, Floor, Ceil, Round, Sin, Cos, Tan, Asin, Acos, Atan,
+    Signum, Cbrt, Log2, Log10, Log1p, Expm1, Rint, ToDegrees, ToRadians,
+)
+from spark_rapids_tpu.exprs.datetime import (
+    Year, Month, DayOfMonth, DayOfWeek, DayOfYear, Quarter, Hour, Minute, Second,
+    DateAdd, DateSub, DateDiff, LastDay,
+)
+from spark_rapids_tpu.exprs.strings import (
+    Length, Upper, Lower, Substring, StringStartsWith, StringEndsWith,
+    StringContains, ConcatStrings, Like, StringTrim, StringTrimLeft, StringTrimRight,
+    StringReplace, StringLocate, StringRPad, StringLPad,
+)
+from spark_rapids_tpu.exprs.aggregates import (
+    AggregateExpression, Sum, Count, Min, Max, Average, First, Last,
+)
+from spark_rapids_tpu.exprs.hashing import Murmur3Hash
+from spark_rapids_tpu.exprs.misc import (
+    MonotonicallyIncreasingID, SparkPartitionID, Rand, KnownFloatingPointNormalized,
+)

@@ -1,0 +1,360 @@
+"""Declarative aggregate functions (reference: AggregateFunctions.scala, 513
+LoC: min/max/sum/count/avg/first/last as declarative cudf agg pairs).
+
+Here each aggregate declares segment-reduce kernels instead of cudf agg pairs:
+``segment_update`` folds raw input rows into per-group buffers and
+``segment_merge`` folds partial buffers; both are plain
+``jax.ops.segment_*`` calls with ``num_segments = capacity`` so shapes stay
+static (worst case: every live row its own group).  ``finalize`` computes the
+result projection (e.g. avg = sum / count).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exprs.base import CpuVal, DevVal, Expression, Literal
+
+
+def _sum_result_type(dt: T.DataType) -> T.DataType:
+    if dt.is_integral:
+        return T.LONG
+    return T.DOUBLE
+
+
+@dataclasses.dataclass
+class AggBufferSpec:
+    dtype: T.DataType
+
+
+class AggregateFunction(Expression):
+    """Base: declares buffers + segment kernels.  Not columnar-evaluable."""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+        self._resolve_type()
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def _resolve_type(self):
+        raise NotImplementedError
+
+    # number and types of intermediate buffers
+    def buffers(self) -> List[AggBufferSpec]:
+        raise NotImplementedError
+
+    def segment_update(self, v: DevVal, seg_ids, num_segments: int,
+                      live_mask) -> List[DevVal]:
+        """Fold input rows into per-group buffers (partial aggregation)."""
+        raise NotImplementedError
+
+    def segment_merge(self, buffers: List[DevVal], seg_ids,
+                      num_segments: int, live_mask) -> List[DevVal]:
+        """Fold partial buffers (final aggregation after shuffle)."""
+        raise NotImplementedError
+
+    def finalize(self, buffers: List[DevVal]) -> DevVal:
+        raise NotImplementedError
+
+    # CPU oracle: reduce a python/numpy group
+    def cpu_reduce(self, values: np.ndarray, validity: np.ndarray):
+        raise NotImplementedError
+
+    def tpu_supported(self, conf):
+        if self.child.dtype.is_string:
+            return f"{self.name} over strings not supported on TPU"
+        if self.child.dtype.is_fractional and not conf.variable_float_agg \
+                and type(self) in (Sum, Average):
+            return (f"{self.name} over floats can produce non-deterministic "
+                    "results; set spark.rapids.sql.variableFloatAgg.enabled")
+        return None
+
+
+def _seg_any_valid(valid, seg_ids, num_segments, live_mask):
+    return jax.ops.segment_max((valid & live_mask).astype(jnp.int32), seg_ids,
+                               num_segments=num_segments) > 0
+
+
+class Sum(AggregateFunction):
+    def _resolve_type(self):
+        self.dtype = _sum_result_type(self.child.dtype)
+        self.nullable = True
+
+    def buffers(self):
+        return [AggBufferSpec(self.dtype), AggBufferSpec(T.BOOLEAN)]
+
+    def segment_update(self, v, seg_ids, num_segments, live_mask):
+        x = v.data.astype(self.dtype.jnp_dtype)
+        use = v.validity & live_mask
+        s = jax.ops.segment_sum(jnp.where(use, x, 0), seg_ids,
+                                num_segments=num_segments)
+        any_v = _seg_any_valid(v.validity, seg_ids, num_segments, live_mask)
+        ones = jnp.ones(num_segments, dtype=jnp.bool_)
+        return [DevVal(self.dtype, s, ones), DevVal(T.BOOLEAN, any_v, ones)]
+
+    def segment_merge(self, buffers, seg_ids, num_segments, live_mask):
+        s, has = buffers
+        total = jax.ops.segment_sum(
+            jnp.where(live_mask, s.data, 0), seg_ids, num_segments=num_segments)
+        any_v = _seg_any_valid(has.data.astype(jnp.bool_), seg_ids,
+                               num_segments, live_mask)
+        ones = jnp.ones(num_segments, dtype=jnp.bool_)
+        return [DevVal(self.dtype, total, ones), DevVal(T.BOOLEAN, any_v, ones)]
+
+    def finalize(self, buffers):
+        s, has = buffers
+        return DevVal(self.dtype, s.data, has.data.astype(jnp.bool_))
+
+    def cpu_reduce(self, values, validity):
+        if not validity.any():
+            return None
+        vals = values[validity]
+        if self.dtype == T.LONG:
+            return int(np.sum(vals.astype(np.int64)))
+        return float(np.sum(vals.astype(np.float64)))
+
+
+class Count(AggregateFunction):
+    def _resolve_type(self):
+        self.dtype = T.LONG
+        self.nullable = False
+
+    def tpu_supported(self, conf):
+        return None
+
+    def buffers(self):
+        return [AggBufferSpec(T.LONG)]
+
+    def segment_update(self, v, seg_ids, num_segments, live_mask):
+        use = v.validity & live_mask
+        c = jax.ops.segment_sum(use.astype(jnp.int64), seg_ids,
+                                num_segments=num_segments)
+        return [DevVal(T.LONG, c, jnp.ones(num_segments, dtype=jnp.bool_))]
+
+    def segment_merge(self, buffers, seg_ids, num_segments, live_mask):
+        c = jax.ops.segment_sum(
+            jnp.where(live_mask, buffers[0].data, 0), seg_ids,
+            num_segments=num_segments)
+        return [DevVal(T.LONG, c, jnp.ones(num_segments, dtype=jnp.bool_))]
+
+    def finalize(self, buffers):
+        return DevVal(T.LONG, buffers[0].data,
+                      jnp.ones_like(buffers[0].data, dtype=jnp.bool_))
+
+    def cpu_reduce(self, values, validity):
+        return int(validity.sum())
+
+
+class _MinMax(AggregateFunction):
+    _is_min = True
+
+    def _resolve_type(self):
+        self.dtype = self.child.dtype
+        self.nullable = True
+
+    def tpu_supported(self, conf):
+        if self.child.dtype.is_string:
+            return f"{self.name} over strings not supported on TPU"
+        return None
+
+    def buffers(self):
+        return [AggBufferSpec(self.dtype), AggBufferSpec(T.BOOLEAN)]
+
+    def _ident(self):
+        jdt = self.dtype.jnp_dtype
+        if self.dtype.is_fractional:
+            return jnp.asarray(jnp.inf if self._is_min else -jnp.inf, dtype=jdt)
+        info = jnp.iinfo(jdt) if self.dtype != T.BOOLEAN else None
+        if self.dtype == T.BOOLEAN:
+            return jnp.asarray(True if self._is_min else False)
+        return jnp.asarray(info.max if self._is_min else info.min, dtype=jdt)
+
+    def _seg_reduce(self, x, seg_ids, num_segments):
+        if self._is_min:
+            return jax.ops.segment_min(x, seg_ids, num_segments=num_segments)
+        return jax.ops.segment_max(x, seg_ids, num_segments=num_segments)
+
+    def segment_update(self, v, seg_ids, num_segments, live_mask):
+        use = v.validity & live_mask
+        x = jnp.where(use, v.data.astype(self.dtype.jnp_dtype), self._ident())
+        m = self._seg_reduce(x, seg_ids, num_segments)
+        any_v = _seg_any_valid(v.validity, seg_ids, num_segments, live_mask)
+        ones = jnp.ones(num_segments, dtype=jnp.bool_)
+        return [DevVal(self.dtype, m, ones), DevVal(T.BOOLEAN, any_v, ones)]
+
+    def segment_merge(self, buffers, seg_ids, num_segments, live_mask):
+        m, has = buffers
+        use = has.data.astype(jnp.bool_) & live_mask
+        x = jnp.where(use, m.data, self._ident())
+        total = self._seg_reduce(x, seg_ids, num_segments)
+        any_v = _seg_any_valid(has.data.astype(jnp.bool_), seg_ids,
+                               num_segments, live_mask)
+        ones = jnp.ones(num_segments, dtype=jnp.bool_)
+        return [DevVal(self.dtype, total, ones), DevVal(T.BOOLEAN, any_v, ones)]
+
+    def finalize(self, buffers):
+        m, has = buffers
+        return DevVal(self.dtype, m.data, has.data.astype(jnp.bool_))
+
+    def cpu_reduce(self, values, validity):
+        if not validity.any():
+            return None
+        vals = values[validity]
+        if self.dtype.is_string:
+            vals = [str(v) for v in vals]
+        r = min(vals) if self._is_min else max(vals)
+        return r
+
+
+class Min(_MinMax):
+    _is_min = True
+
+
+class Max(_MinMax):
+    _is_min = False
+
+
+class Average(AggregateFunction):
+    def _resolve_type(self):
+        self.dtype = T.DOUBLE
+        self.nullable = True
+
+    def buffers(self):
+        return [AggBufferSpec(T.DOUBLE), AggBufferSpec(T.LONG)]
+
+    def segment_update(self, v, seg_ids, num_segments, live_mask):
+        use = v.validity & live_mask
+        x = v.data.astype(jnp.float64)
+        s = jax.ops.segment_sum(jnp.where(use, x, 0.0), seg_ids,
+                                num_segments=num_segments)
+        c = jax.ops.segment_sum(use.astype(jnp.int64), seg_ids,
+                                num_segments=num_segments)
+        ones = jnp.ones(num_segments, dtype=jnp.bool_)
+        return [DevVal(T.DOUBLE, s, ones), DevVal(T.LONG, c, ones)]
+
+    def segment_merge(self, buffers, seg_ids, num_segments, live_mask):
+        s, c = buffers
+        st = jax.ops.segment_sum(jnp.where(live_mask, s.data, 0.0), seg_ids,
+                                 num_segments=num_segments)
+        ct = jax.ops.segment_sum(jnp.where(live_mask, c.data, 0), seg_ids,
+                                 num_segments=num_segments)
+        ones = jnp.ones(num_segments, dtype=jnp.bool_)
+        return [DevVal(T.DOUBLE, st, ones), DevVal(T.LONG, ct, ones)]
+
+    def finalize(self, buffers):
+        s, c = buffers
+        nonzero = c.data > 0
+        data = s.data / jnp.where(nonzero, c.data, 1).astype(jnp.float64)
+        return DevVal(T.DOUBLE, data, nonzero)
+
+    def cpu_reduce(self, values, validity):
+        if not validity.any():
+            return None
+        vals = values[validity].astype(np.float64)
+        return float(np.sum(vals) / len(vals))
+
+
+class _FirstLast(AggregateFunction):
+    _is_first = True
+
+    def __init__(self, child: Expression, ignore_nulls: bool = False):
+        self.ignore_nulls = ignore_nulls
+        super().__init__(child)
+
+    def with_children(self, children):
+        return type(self)(children[0], self.ignore_nulls)
+
+    def _resolve_type(self):
+        self.dtype = self.child.dtype
+        self.nullable = True
+
+    def buffers(self):
+        # value + validity + the row index it came from (for merge ordering)
+        return [AggBufferSpec(self.dtype), AggBufferSpec(T.BOOLEAN),
+                AggBufferSpec(T.LONG)]
+
+    def _pick(self, v_data, v_valid, idx, seg_ids, num_segments, live_mask):
+        cap = int(idx.shape[0])
+        candidate = live_mask & (v_valid if self.ignore_nulls
+                                 else jnp.ones_like(v_valid))
+        big = jnp.int64(jnp.iinfo(jnp.int64).max // 2)
+        key = jnp.where(candidate, idx, big if self._is_first else -big)
+        if self._is_first:
+            best = jax.ops.segment_min(key, seg_ids, num_segments=num_segments)
+        else:
+            best = jax.ops.segment_max(key, seg_ids, num_segments=num_segments)
+        # Scatter values of winners into group slots.
+        winner = candidate & (best[seg_ids] == key)
+        out_val = jnp.zeros(num_segments, dtype=v_data.dtype)
+        out_val = out_val.at[jnp.where(winner, seg_ids, num_segments)].set(
+            v_data, mode="drop")
+        out_ok = jnp.zeros(num_segments, dtype=jnp.bool_)
+        out_ok = out_ok.at[jnp.where(winner, seg_ids, num_segments)].set(
+            v_valid, mode="drop")
+        has = jax.ops.segment_max(candidate.astype(jnp.int32), seg_ids,
+                                  num_segments=num_segments) > 0
+        best_idx = jnp.where(has, best, 0)
+        return out_val, out_ok & has, best_idx
+
+    def segment_update(self, v, seg_ids, num_segments, live_mask):
+        cap = int(v.data.shape[0])
+        idx = jnp.arange(cap, dtype=jnp.int64)
+        val, ok, bidx = self._pick(v.data.astype(self.dtype.jnp_dtype),
+                                   v.validity, idx, seg_ids, num_segments,
+                                   live_mask)
+        ones = jnp.ones(num_segments, dtype=jnp.bool_)
+        return [DevVal(self.dtype, val, ones), DevVal(T.BOOLEAN, ok, ones),
+                DevVal(T.LONG, bidx, ones)]
+
+    def segment_merge(self, buffers, seg_ids, num_segments, live_mask):
+        val, ok, idx = buffers
+        nv, nok, nidx = self._pick(val.data, ok.data.astype(jnp.bool_),
+                                   idx.data, seg_ids, num_segments, live_mask)
+        ones = jnp.ones(num_segments, dtype=jnp.bool_)
+        return [DevVal(self.dtype, nv, ones), DevVal(T.BOOLEAN, nok, ones),
+                DevVal(T.LONG, nidx, ones)]
+
+    def finalize(self, buffers):
+        val, ok, _ = buffers
+        return DevVal(self.dtype, val.data, ok.data.astype(jnp.bool_))
+
+    def cpu_reduce(self, values, validity):
+        order = range(len(values)) if self._is_first else \
+            range(len(values) - 1, -1, -1)
+        for i in order:
+            if self.ignore_nulls and not validity[i]:
+                continue
+            return values[i] if validity[i] else None
+        return None
+
+
+class First(_FirstLast):
+    _is_first = True
+
+
+class Last(_FirstLast):
+    _is_first = False
+
+
+@dataclasses.dataclass
+class AggregateExpression:
+    """An aggregate call in an output position: fn + output name."""
+
+    fn: AggregateFunction
+    output_name: str
+
+    @property
+    def dtype(self):
+        return self.fn.dtype
+
+
+def count_star() -> Count:
+    return Count(Literal(1, T.INT))

@@ -1,0 +1,182 @@
+"""Arithmetic expressions (reference: arithmetic.scala, 227 LoC).
+
+Spark non-ANSI semantics: division/modulo by zero yields NULL; integral
+overflow wraps (java semantics), which matches jnp/numpy fixed-width ints.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exprs.base import (
+    BinaryExpression, CpuVal, DevVal, Expression, UnaryExpression,
+    cast_cpu, cast_dev, promote_cpu, promote_dev,
+)
+
+
+class _BinaryArithmetic(BinaryExpression):
+    def _compute(self, x, y):
+        raise NotImplementedError
+
+    def tpu_eval(self, ctx) -> DevVal:
+        a, b, out = promote_dev(self.left.tpu_eval(ctx), self.right.tpu_eval(ctx))
+        data = self._compute(a.data, b.data)
+        return DevVal(out, data.astype(out.jnp_dtype), a.validity & b.validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        a, b, out = promote_cpu(self.left.cpu_eval(ctx), self.right.cpu_eval(ctx))
+        with np.errstate(all="ignore"):
+            data = self._compute(a.values, b.values)
+        return CpuVal(out, data.astype(out.np_dtype), a.validity & b.validity)
+
+
+class Add(_BinaryArithmetic):
+    def _compute(self, x, y):
+        return x + y
+
+
+class Subtract(_BinaryArithmetic):
+    def _compute(self, x, y):
+        return x - y
+
+
+class Multiply(_BinaryArithmetic):
+    def _compute(self, x, y):
+        return x * y
+
+
+class Divide(BinaryExpression):
+    """Spark '/' : always double result; x/0 -> NULL (non-ANSI)."""
+
+    def _resolve_type(self):
+        self.dtype = T.DOUBLE
+        self.nullable = True
+
+    def tpu_eval(self, ctx) -> DevVal:
+        a = cast_dev(self.left.tpu_eval(ctx), T.DOUBLE)
+        b = cast_dev(self.right.tpu_eval(ctx), T.DOUBLE)
+        zero = b.data == 0.0
+        data = a.data / jnp.where(zero, 1.0, b.data)
+        return DevVal(T.DOUBLE, data, a.validity & b.validity & ~zero)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        a = cast_cpu(self.left.cpu_eval(ctx), T.DOUBLE)
+        b = cast_cpu(self.right.cpu_eval(ctx), T.DOUBLE)
+        zero = b.values == 0.0
+        with np.errstate(all="ignore"):
+            data = a.values / np.where(zero, 1.0, b.values)
+        return CpuVal(T.DOUBLE, data, a.validity & b.validity & ~zero)
+
+
+class IntegralDivide(BinaryExpression):
+    """Spark 'div': long result; x div 0 -> NULL."""
+
+    def _resolve_type(self):
+        self.dtype = T.LONG
+        self.nullable = True
+
+    def tpu_eval(self, ctx) -> DevVal:
+        a = cast_dev(self.left.tpu_eval(ctx), T.LONG)
+        b = cast_dev(self.right.tpu_eval(ctx), T.LONG)
+        zero = b.data == 0
+        den = jnp.where(zero, 1, b.data)
+        # Java integer division truncates toward zero; jnp // floors.
+        q = jnp.sign(a.data) * jnp.sign(den) * (jnp.abs(a.data) // jnp.abs(den))
+        return DevVal(T.LONG, q.astype(jnp.int64), a.validity & b.validity & ~zero)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        a = cast_cpu(self.left.cpu_eval(ctx), T.LONG)
+        b = cast_cpu(self.right.cpu_eval(ctx), T.LONG)
+        zero = b.values == 0
+        den = np.where(zero, 1, b.values)
+        with np.errstate(all="ignore"):
+            q = (np.sign(a.values) * np.sign(den)
+                 * (np.abs(a.values) // np.abs(den)))
+        return CpuVal(T.LONG, q.astype(np.int64), a.validity & b.validity & ~zero)
+
+
+class Remainder(BinaryExpression):
+    """Spark '%': java semantics (sign of dividend); x % 0 -> NULL."""
+
+    def tpu_eval(self, ctx) -> DevVal:
+        a, b, out = promote_dev(self.left.tpu_eval(ctx), self.right.tpu_eval(ctx))
+        zero = b.data == 0
+        den = jnp.where(zero, 1, b.data)
+        # java remainder: a - trunc(a/den)*den
+        if out.is_fractional:
+            r = jnp.fmod(a.data, den)
+        else:
+            q = jnp.sign(a.data) * jnp.sign(den) * (jnp.abs(a.data) // jnp.abs(den))
+            r = a.data - q * den
+        return DevVal(out, r.astype(out.jnp_dtype), a.validity & b.validity & ~zero)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        a, b, out = promote_cpu(self.left.cpu_eval(ctx), self.right.cpu_eval(ctx))
+        zero = b.values == 0
+        den = np.where(zero, 1, b.values)
+        with np.errstate(all="ignore"):
+            if out.is_fractional:
+                r = np.fmod(a.values, den)
+            else:
+                q = (np.sign(a.values) * np.sign(den)
+                     * (np.abs(a.values) // np.abs(den)))
+                r = a.values - q * den
+        return CpuVal(out, r.astype(out.np_dtype), a.validity & b.validity & ~zero)
+
+
+class Pmod(BinaryExpression):
+    """Spark pmod: r = a % n (java remainder, sign of dividend); if r < 0
+    then (r + n) % n — note the result takes the divisor's sign for negative
+    divisors, it is NOT forced non-negative."""
+
+    @staticmethod
+    def _java_rem(a, den, xp):
+        q = xp.sign(a) * xp.sign(den) * (xp.abs(a) // xp.abs(den))
+        return a - q * den
+
+    def tpu_eval(self, ctx) -> DevVal:
+        a, b, out = promote_dev(self.left.tpu_eval(ctx), self.right.tpu_eval(ctx))
+        zero = b.data == 0
+        den = jnp.where(zero, 1, b.data)
+        if out.is_fractional:
+            r = jnp.fmod(a.data, den)
+        else:
+            r = self._java_rem(a.data, den, jnp)
+        r2 = self._java_rem(r + den, den, jnp)
+        r = jnp.where(r < 0, r2, r)
+        return DevVal(out, r.astype(out.jnp_dtype), a.validity & b.validity & ~zero)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        a, b, out = promote_cpu(self.left.cpu_eval(ctx), self.right.cpu_eval(ctx))
+        zero = b.values == 0
+        den = np.where(zero, 1, b.values)
+        with np.errstate(all="ignore"):
+            if out.is_fractional:
+                r = np.fmod(a.values, den)
+            else:
+                r = self._java_rem(a.values, den, np)
+            r2 = self._java_rem(r + den, den, np)
+            r = np.where(r < 0, r2, r)
+        return CpuVal(out, r.astype(out.np_dtype), a.validity & b.validity & ~zero)
+
+
+class UnaryMinus(UnaryExpression):
+    def tpu_eval(self, ctx) -> DevVal:
+        v = self.child.tpu_eval(ctx)
+        return DevVal(v.dtype, -v.data, v.validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        v = self.child.cpu_eval(ctx)
+        return CpuVal(v.dtype, (-v.values).astype(v.dtype.np_dtype), v.validity)
+
+
+class Abs(UnaryExpression):
+    def tpu_eval(self, ctx) -> DevVal:
+        v = self.child.tpu_eval(ctx)
+        return DevVal(v.dtype, jnp.abs(v.data), v.validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        v = self.child.cpu_eval(ctx)
+        return CpuVal(v.dtype, np.abs(v.values), v.validity)

@@ -1,0 +1,177 @@
+"""CAST (reference: GpuCast.scala, 884 LoC).
+
+Device-supported casts: between numeric types (Java narrowing semantics:
+NaN->0, saturation at int bounds, truncation toward zero), boolean<->numeric,
+date<->timestamp, numeric<->timestamp (seconds).  Casts involving strings run
+on CPU only (the reference likewise special-cases string casts heavily,
+GpuCast.scala:262-337).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exprs.base import CpuVal, DevVal, Expression, UnaryExpression
+
+_INT_BOUNDS = {
+    T.BYTE: (-(2 ** 7), 2 ** 7 - 1),
+    T.SHORT: (-(2 ** 15), 2 ** 15 - 1),
+    T.INT: (-(2 ** 31), 2 ** 31 - 1),
+    T.LONG: (-(2 ** 63), 2 ** 63 - 1),
+}
+
+
+class Cast(UnaryExpression):
+    def __init__(self, child: Expression, to: T.DataType):
+        self.to = to
+        super().__init__(child)
+
+    def with_children(self, children):
+        return Cast(children[0], self.to)
+
+    def _resolve_type(self):
+        self.dtype = self.to
+        self.nullable = self.child.nullable or (
+            self.child.dtype.is_string and not self.to.is_string)
+
+    @property
+    def name(self):
+        return f"Cast(->{self.to})"
+
+    def tpu_supported(self, conf):
+        src, dst = self.child.dtype, self.to
+        if src.is_string != dst.is_string and (src.is_string or dst.is_string):
+            return f"cast {src} -> {dst} involves string conversion (CPU only)"
+        return None
+
+    # -- device ------------------------------------------------------------
+
+    def tpu_eval(self, ctx) -> DevVal:
+        v = self.child.tpu_eval(ctx)
+        src, dst = v.dtype, self.to
+        if src == dst:
+            return v
+        data, validity = v.data, v.validity
+        if src == T.BOOLEAN:
+            data = data.astype(dst.jnp_dtype)
+        elif src.is_fractional and dst.is_integral:
+            lo, hi = _INT_BOUNDS[dst]
+            x = jnp.nan_to_num(data, nan=0.0, posinf=float(hi), neginf=float(lo))
+            x = jnp.clip(x, float(lo), float(hi))
+            data = jnp.trunc(x).astype(dst.jnp_dtype)
+        elif dst == T.BOOLEAN:
+            data = data != 0
+        elif src == T.DATE and dst == T.TIMESTAMP:
+            data = data.astype(jnp.int64) * 86_400_000_000
+        elif src == T.TIMESTAMP and dst == T.DATE:
+            data = jnp.floor_divide(data, 86_400_000_000).astype(jnp.int32)
+        elif src == T.TIMESTAMP and dst.is_numeric:
+            data = jnp.floor_divide(data, 1_000_000).astype(dst.jnp_dtype)
+        elif src.is_numeric and dst == T.TIMESTAMP:
+            data = (data.astype(jnp.float64) * 1e6).astype(jnp.int64) \
+                if src.is_fractional else data.astype(jnp.int64) * 1_000_000
+        else:
+            data = data.astype(dst.jnp_dtype)
+        return DevVal(dst, data, validity)
+
+    # -- cpu ---------------------------------------------------------------
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        v = self.child.cpu_eval(ctx)
+        src, dst = v.dtype, self.to
+        if src == dst:
+            return v
+        validity = v.validity.copy()
+        with np.errstate(all="ignore"):
+            if src.is_string:
+                values, validity = _cast_from_string(v, dst)
+            elif dst.is_string:
+                values = np.array(
+                    [_to_string(x, src) for x in v.values], dtype=object)
+            elif src == T.BOOLEAN:
+                values = v.values.astype(dst.np_dtype)
+            elif src.is_fractional and dst.is_integral:
+                lo, hi = _INT_BOUNDS[dst]
+                x = np.nan_to_num(v.values.astype(np.float64), nan=0.0,
+                                  posinf=float(hi), neginf=float(lo))
+                values = np.trunc(np.clip(x, float(lo), float(hi))).astype(
+                    dst.np_dtype)
+            elif dst == T.BOOLEAN:
+                values = v.values != 0
+            elif src == T.DATE and dst == T.TIMESTAMP:
+                values = v.values.astype(np.int64) * 86_400_000_000
+            elif src == T.TIMESTAMP and dst == T.DATE:
+                values = np.floor_divide(v.values, 86_400_000_000).astype(np.int32)
+            elif src == T.TIMESTAMP and dst.is_numeric:
+                values = np.floor_divide(v.values, 1_000_000).astype(dst.np_dtype)
+            elif src.is_numeric and dst == T.TIMESTAMP:
+                values = ((v.values.astype(np.float64) * 1e6).astype(np.int64)
+                          if src.is_fractional
+                          else v.values.astype(np.int64) * 1_000_000)
+            else:
+                values = v.values.astype(dst.np_dtype)
+        return CpuVal(dst, values, validity)
+
+
+def _to_string(x, src: T.DataType) -> str:
+    if src == T.BOOLEAN:
+        return "true" if x else "false"
+    if src.is_integral:
+        return str(int(x))
+    if src.is_fractional:
+        f = float(x)
+        if f != f:
+            return "NaN"
+        if f == int(f) and abs(f) < 1e16:
+            return f"{f:.1f}"
+        return repr(f)
+    if src == T.DATE:
+        days = int(x)
+        import datetime
+        return (datetime.date(1970, 1, 1)
+                + datetime.timedelta(days=days)).isoformat()
+    if src == T.TIMESTAMP:
+        import datetime
+        dt = datetime.datetime(1970, 1, 1) + datetime.timedelta(
+            microseconds=int(x))
+        return dt.strftime("%Y-%m-%d %H:%M:%S")
+    return str(x)
+
+
+def _cast_from_string(v: CpuVal, dst: T.DataType):
+    out_validity = v.validity.copy()
+    values = np.zeros(len(v.values), dtype=dst.np_dtype if not dst.is_string
+                      else object)
+    for i, (s, ok) in enumerate(zip(v.values, v.validity)):
+        if not ok:
+            continue
+        s = str(s).strip()
+        try:
+            if dst == T.BOOLEAN:
+                low = s.lower()
+                if low in ("true", "t", "yes", "y", "1"):
+                    values[i] = True
+                elif low in ("false", "f", "no", "n", "0"):
+                    values[i] = False
+                else:
+                    out_validity[i] = False
+            elif dst.is_integral:
+                values[i] = dst.np_dtype(int(float(s)) if "." in s else int(s))
+            elif dst.is_fractional:
+                values[i] = dst.np_dtype(float(s))
+            elif dst == T.DATE:
+                import datetime
+                d = datetime.date.fromisoformat(s[:10])
+                values[i] = (d - datetime.date(1970, 1, 1)).days
+            elif dst == T.TIMESTAMP:
+                import datetime
+                dt = datetime.datetime.fromisoformat(s)
+                values[i] = int(
+                    (dt - datetime.datetime(1970, 1, 1)).total_seconds() * 1e6)
+            else:
+                values[i] = s
+        except (ValueError, OverflowError):
+            out_validity[i] = False
+    return values, out_validity

@@ -1,0 +1,220 @@
+"""Date/time expressions (reference: datetimeExpressions.scala, 560 LoC).
+
+Calendar decomposition uses the branch-free civil-from-days algorithm
+(integer-only, fully vectorizable), identical code shape for jnp and numpy —
+no data-dependent control flow, so it lowers cleanly to XLA.
+Timestamps are UTC microseconds (the reference requires UTC too).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exprs.base import (
+    BinaryExpression, CpuVal, DevVal, Expression, UnaryExpression,
+)
+
+MICROS_PER_DAY = 86_400_000_000
+
+
+def civil_from_days(days, xp):
+    """days-since-epoch -> (year, month, day); xp is jnp or np."""
+    days = days.astype(xp.int64)
+    z = days + 719468
+    era = xp.floor_divide(z, 146097)
+    doe = z - era * 146097
+    yoe = xp.floor_divide(
+        doe - xp.floor_divide(doe, 1460) + xp.floor_divide(doe, 36524)
+        - xp.floor_divide(doe, 146096), 365)
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + xp.floor_divide(yoe, 4) - xp.floor_divide(yoe, 100))
+    mp = xp.floor_divide(5 * doy + 2, 153)
+    d = doy - xp.floor_divide(153 * mp + 2, 5) + 1
+    m = mp + xp.where(mp < 10, 3, -9)
+    y = y + (m <= 2)
+    return y, m, d
+
+
+def days_from_civil(y, m, d, xp):
+    y = y.astype(xp.int64) - (m <= 2)
+    era = xp.floor_divide(y, 400)
+    yoe = y - era * 400
+    mp = m + xp.where(m > 2, -3, 9)
+    doy = xp.floor_divide(153 * mp + 2, 5) + d - 1
+    doe = yoe * 365 + xp.floor_divide(yoe, 4) - xp.floor_divide(yoe, 100) + doy
+    return era * 146097 + doe - 719468
+
+
+def _days_of(v, xp):
+    if v.dtype == T.TIMESTAMP:
+        data = v.values if xp is np else v.data
+        return xp.floor_divide(data, MICROS_PER_DAY)
+    return (v.values if xp is np else v.data)
+
+
+class _DatePart(UnaryExpression):
+    def _resolve_type(self):
+        self.dtype = T.INT
+        self.nullable = self.child.nullable
+
+    def _part(self, days, xp):
+        raise NotImplementedError
+
+    def tpu_eval(self, ctx) -> DevVal:
+        v = self.child.tpu_eval(ctx)
+        out = self._part(_days_of(v, jnp), jnp)
+        return DevVal(T.INT, out.astype(jnp.int32), v.validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        v = self.child.cpu_eval(ctx)
+        out = self._part(_days_of(v, np), np)
+        return CpuVal(T.INT, out.astype(np.int32), v.validity)
+
+
+class Year(_DatePart):
+    def _part(self, days, xp):
+        y, _, _ = civil_from_days(days, xp)
+        return y
+
+
+class Month(_DatePart):
+    def _part(self, days, xp):
+        _, m, _ = civil_from_days(days, xp)
+        return m
+
+
+class DayOfMonth(_DatePart):
+    def _part(self, days, xp):
+        _, _, d = civil_from_days(days, xp)
+        return d
+
+
+class DayOfWeek(_DatePart):
+    """1 = Sunday ... 7 = Saturday (Spark semantics)."""
+
+    def _part(self, days, xp):
+        # 1970-01-01 was a Thursday (dow=5 in Spark numbering).
+        return xp.mod(days.astype(xp.int64) + 4, 7) + 1
+
+
+class DayOfYear(_DatePart):
+    def _part(self, days, xp):
+        y, _, _ = civil_from_days(days, xp)
+        jan1 = days_from_civil(y, xp.full_like(y, 1), xp.full_like(y, 1), xp)
+        return days.astype(xp.int64) - jan1 + 1
+
+
+class Quarter(_DatePart):
+    def _part(self, days, xp):
+        _, m, _ = civil_from_days(days, xp)
+        return xp.floor_divide(m - 1, 3) + 1
+
+
+class _TimePart(UnaryExpression):
+    def _resolve_type(self):
+        self.dtype = T.INT
+        self.nullable = self.child.nullable
+
+    def _part(self, micros, xp):
+        raise NotImplementedError
+
+    def tpu_eval(self, ctx) -> DevVal:
+        v = self.child.tpu_eval(ctx)
+        tod = jnp.mod(v.data, MICROS_PER_DAY)
+        return DevVal(T.INT, self._part(tod, jnp).astype(jnp.int32), v.validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        v = self.child.cpu_eval(ctx)
+        tod = np.mod(v.values, MICROS_PER_DAY)
+        return CpuVal(T.INT, self._part(tod, np).astype(np.int32), v.validity)
+
+
+class Hour(_TimePart):
+    def _part(self, tod, xp):
+        return xp.floor_divide(tod, 3_600_000_000)
+
+
+class Minute(_TimePart):
+    def _part(self, tod, xp):
+        return xp.mod(xp.floor_divide(tod, 60_000_000), 60)
+
+
+class Second(_TimePart):
+    def _part(self, tod, xp):
+        return xp.mod(xp.floor_divide(tod, 1_000_000), 60)
+
+
+class DateAdd(BinaryExpression):
+    def _resolve_type(self):
+        self.dtype = T.DATE
+        self.nullable = self.left.nullable or self.right.nullable
+
+    def tpu_eval(self, ctx) -> DevVal:
+        a, b = self.left.tpu_eval(ctx), self.right.tpu_eval(ctx)
+        return DevVal(T.DATE, (a.data + b.data.astype(jnp.int32)).astype(jnp.int32),
+                      a.validity & b.validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        a, b = self.left.cpu_eval(ctx), self.right.cpu_eval(ctx)
+        return CpuVal(T.DATE,
+                      (a.values + b.values.astype(np.int32)).astype(np.int32),
+                      a.validity & b.validity)
+
+
+class DateSub(BinaryExpression):
+    def _resolve_type(self):
+        self.dtype = T.DATE
+        self.nullable = self.left.nullable or self.right.nullable
+
+    def tpu_eval(self, ctx) -> DevVal:
+        a, b = self.left.tpu_eval(ctx), self.right.tpu_eval(ctx)
+        return DevVal(T.DATE, (a.data - b.data.astype(jnp.int32)).astype(jnp.int32),
+                      a.validity & b.validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        a, b = self.left.cpu_eval(ctx), self.right.cpu_eval(ctx)
+        return CpuVal(T.DATE,
+                      (a.values - b.values.astype(np.int32)).astype(np.int32),
+                      a.validity & b.validity)
+
+
+class DateDiff(BinaryExpression):
+    def _resolve_type(self):
+        self.dtype = T.INT
+        self.nullable = self.left.nullable or self.right.nullable
+
+    def tpu_eval(self, ctx) -> DevVal:
+        a, b = self.left.tpu_eval(ctx), self.right.tpu_eval(ctx)
+        return DevVal(T.INT, (a.data - b.data).astype(jnp.int32),
+                      a.validity & b.validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        a, b = self.left.cpu_eval(ctx), self.right.cpu_eval(ctx)
+        return CpuVal(T.INT, (a.values - b.values).astype(np.int32),
+                      a.validity & b.validity)
+
+
+class LastDay(UnaryExpression):
+    def _resolve_type(self):
+        self.dtype = T.DATE
+        self.nullable = self.child.nullable
+
+    @staticmethod
+    def _last_day(days, xp):
+        y, m, _ = civil_from_days(days, xp)
+        ny = y + (m == 12)
+        nm = xp.where(m == 12, 1, m + 1)
+        first_next = days_from_civil(ny, nm, xp.full_like(ny, 1), xp)
+        return first_next - 1
+
+    def tpu_eval(self, ctx) -> DevVal:
+        v = self.child.tpu_eval(ctx)
+        return DevVal(T.DATE, self._last_day(v.data, jnp).astype(jnp.int32),
+                      v.validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        v = self.child.cpu_eval(ctx)
+        return CpuVal(T.DATE, self._last_day(v.values, np).astype(np.int32),
+                      v.validity)

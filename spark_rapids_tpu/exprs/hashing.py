@@ -1,0 +1,155 @@
+"""Hash expressions: Spark-compatible murmur3_x86_32 (seed 42) for fixed-width
+types, used by hash partitioning and hash joins (reference:
+GpuHashPartitioning.scala — "cudf murmur3-compatible hash").
+
+Everything is uint32 modular arithmetic, fully elementwise -> lowers to pure
+VPU work on TPU.  Strings use the polynomial row hashes from
+exprs.strings (engine-internal determinism is all partitioning needs).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exprs.base import CpuVal, DevVal, Expression
+
+_C1 = 0xCC9E2D51
+_C2 = 0x1B873593
+
+
+def _rotl32(x, r, xp):
+    return ((x << xp.uint32(r)) | (x >> xp.uint32(32 - r))).astype(xp.uint32)
+
+
+def _mix_k1(k1, xp):
+    k1 = (k1 * xp.uint32(_C1)).astype(xp.uint32)
+    k1 = _rotl32(k1, 15, xp)
+    return (k1 * xp.uint32(_C2)).astype(xp.uint32)
+
+
+def _mix_h1(h1, k1, xp):
+    h1 = (h1 ^ k1).astype(xp.uint32)
+    h1 = _rotl32(h1, 13, xp)
+    return (h1 * xp.uint32(5) + xp.uint32(0xE6546B64)).astype(xp.uint32)
+
+
+def _fmix(h, length, xp):
+    h = (h ^ xp.uint32(length)).astype(xp.uint32)
+    h = h ^ (h >> xp.uint32(16))
+    h = (h * xp.uint32(0x85EBCA6B)).astype(xp.uint32)
+    h = h ^ (h >> xp.uint32(13))
+    h = (h * xp.uint32(0xC2B2AE35)).astype(xp.uint32)
+    return h ^ (h >> xp.uint32(16))
+
+
+def _words_of(v: DevVal, xp):
+    """Decompose a fixed-width column into 32-bit words (Spark layout:
+    int-like promoted to int; long/double as two words low,high)."""
+    dt = v.dtype
+    data = v.data
+    if dt in (T.BOOLEAN, T.BYTE, T.SHORT, T.INT, T.DATE):
+        return [data.astype(xp.int32).astype(xp.uint32)], 4
+    if dt in (T.LONG, T.TIMESTAMP):
+        x = data.astype(xp.int64).astype(xp.uint64)
+        lo = (x & xp.uint64(0xFFFFFFFF)).astype(xp.uint32)
+        hi = (x >> xp.uint64(32)).astype(xp.uint32)
+        return [lo, hi], 8
+    if dt == T.FLOAT:
+        # normalize -0.0 to 0.0 like Spark
+        x = xp.where(data == 0, xp.zeros_like(data), data)
+        bits = x.astype(xp.float32)
+        u = np.frombuffer(np.asarray(bits).tobytes(), dtype=np.uint32) \
+            if xp is np else None
+        if xp is np:
+            return [u.copy()], 4
+        import jax
+        return [jax.lax.bitcast_convert_type(bits, jnp.uint32)], 4
+    if dt == T.DOUBLE:
+        x = xp.where(data == 0, xp.zeros_like(data), data)
+        if xp is np:
+            u = np.frombuffer(np.asarray(x, dtype=np.float64).tobytes(),
+                              dtype=np.uint32).copy()
+            lo, hi = u[0::2], u[1::2]  # little endian
+        else:
+            import jax
+            # f64 -> u32[...,2]; avoids u64 bitcast which TPU's X64 rewriting
+            # does not support.
+            pair = jax.lax.bitcast_convert_type(x.astype(jnp.float64),
+                                                jnp.uint32)
+            lo, hi = pair[..., 0], pair[..., 1]
+        return [lo, hi], 8
+    raise TypeError(f"murmur3 on {dt}")
+
+
+def murmur3_cols(vals: Sequence[DevVal], seed: int = 42):
+    """Combined row hash over several device columns (Spark semantics: each
+    column's hash feeds the next as seed; NULL columns are skipped)."""
+    cap = None
+    for v in vals:
+        cap = int(v.validity.shape[0])
+        break
+    h = jnp.full(cap, np.uint32(seed), dtype=jnp.uint32)
+    for v in vals:
+        if v.dtype.is_string:
+            from spark_rapids_tpu.exprs.strings import string_hash2
+            h1, _ = string_hash2(v)
+            lo = (h1 & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+            hi = (h1 >> jnp.uint64(32)).astype(jnp.uint32)
+            words, length = [lo, hi], 8
+        else:
+            words, length = _words_of(v, jnp)
+        hv = h
+        for w in words:
+            hv = _mix_h1(hv, _mix_k1(w, jnp), jnp)
+        hv = _fmix(hv, length, jnp)
+        # NULL input leaves the running hash unchanged (Spark semantics).
+        h = jnp.where(v.validity, hv, h)
+    return h.astype(jnp.int32)
+
+
+def murmur3_cols_cpu(vals: Sequence[CpuVal], seed: int = 42):
+    n = len(vals[0].validity)
+    h = np.full(n, np.uint32(seed), dtype=np.uint32)
+    with np.errstate(over="ignore"):
+        for v in vals:
+            if v.dtype.is_string:
+                from spark_rapids_tpu.exprs.strings import hash_literal2
+                h1 = np.array([hash_literal2(str(s))[0] for s in v.values],
+                              dtype=np.uint64)
+                lo = (h1 & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+                hi = (h1 >> np.uint64(32)).astype(np.uint32)
+                words, length = [lo, hi], 8
+            else:
+                words, length = _words_of(
+                    DevVal(v.dtype, v.values, v.validity), np)
+            hv = h
+            for w in words:
+                hv = _mix_h1(hv, _mix_k1(w, np), np)
+            hv = _fmix(hv, length, np)
+            h = np.where(v.validity, hv, h)
+    return h.astype(np.int32)
+
+
+class Murmur3Hash(Expression):
+    def __init__(self, *children: Expression, seed: int = 42):
+        self.children = tuple(children)
+        self.seed = seed
+        self.dtype = T.INT
+        self.nullable = False
+
+    def with_children(self, children):
+        return Murmur3Hash(*children, seed=self.seed)
+
+    def tpu_eval(self, ctx) -> DevVal:
+        vals = [c.tpu_eval(ctx) for c in self.children]
+        data = murmur3_cols(vals, self.seed)
+        return DevVal(T.INT, data, jnp.ones_like(data, dtype=jnp.bool_))
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        vals = [c.cpu_eval(ctx) for c in self.children]
+        data = murmur3_cols_cpu(vals, self.seed)
+        return CpuVal(T.INT, data, np.ones(len(data), dtype=np.bool_))

@@ -1,0 +1,116 @@
+"""Math expressions (reference: mathExpressions.scala, 378 LoC).
+
+All unary math returns double (Spark semantics); domain errors produce NaN,
+matching Spark CPU (java.lang.Math) behavior.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exprs.base import (
+    BinaryExpression, CpuVal, DevVal, UnaryExpression, cast_cpu, cast_dev,
+)
+
+
+class _UnaryMathExpression(UnaryExpression):
+    _jnp = None  # staticmethod set by _make_unary
+    _np = None
+
+    def _resolve_type(self):
+        self.dtype = T.DOUBLE
+        self.nullable = self.child.nullable
+
+    def tpu_eval(self, ctx) -> DevVal:
+        v = cast_dev(self.child.tpu_eval(ctx), T.DOUBLE)
+        return DevVal(T.DOUBLE, self._jnp(v.data), v.validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        v = cast_cpu(self.child.cpu_eval(ctx), T.DOUBLE)
+        with np.errstate(all="ignore"):
+            data = self._np(v.values)
+        return CpuVal(T.DOUBLE, np.asarray(data, dtype=np.float64), v.validity)
+
+
+def _make_unary(name, jnp_fn, np_fn):
+    return type(name, (_UnaryMathExpression,), {
+        "_jnp": staticmethod(jnp_fn),
+        "_np": staticmethod(np_fn),
+    })
+
+
+Sqrt = _make_unary("Sqrt", jnp.sqrt, np.sqrt)
+Exp = _make_unary("Exp", jnp.exp, np.exp)
+Log = _make_unary("Log", jnp.log, np.log)
+Log2 = _make_unary("Log2", jnp.log2, np.log2)
+Log10 = _make_unary("Log10", jnp.log10, np.log10)
+Log1p = _make_unary("Log1p", jnp.log1p, np.log1p)
+Expm1 = _make_unary("Expm1", jnp.expm1, np.expm1)
+Floor = _make_unary("Floor", jnp.floor, np.floor)
+Ceil = _make_unary("Ceil", jnp.ceil, np.ceil)
+Sin = _make_unary("Sin", jnp.sin, np.sin)
+Cos = _make_unary("Cos", jnp.cos, np.cos)
+Tan = _make_unary("Tan", jnp.tan, np.tan)
+Asin = _make_unary("Asin", jnp.arcsin, np.arcsin)
+Acos = _make_unary("Acos", jnp.arccos, np.arccos)
+Atan = _make_unary("Atan", jnp.arctan, np.arctan)
+Cbrt = _make_unary("Cbrt", jnp.cbrt, np.cbrt)
+Signum = _make_unary("Signum", jnp.sign, np.sign)
+Rint = _make_unary("Rint", jnp.rint, np.rint)
+ToDegrees = _make_unary("ToDegrees", jnp.degrees, np.degrees)
+ToRadians = _make_unary("ToRadians", jnp.radians, np.radians)
+
+
+class Pow(BinaryExpression):
+    def _resolve_type(self):
+        self.dtype = T.DOUBLE
+        self.nullable = self.left.nullable or self.right.nullable
+
+    def tpu_eval(self, ctx) -> DevVal:
+        a = cast_dev(self.left.tpu_eval(ctx), T.DOUBLE)
+        b = cast_dev(self.right.tpu_eval(ctx), T.DOUBLE)
+        return DevVal(T.DOUBLE, jnp.power(a.data, b.data), a.validity & b.validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        a = cast_cpu(self.left.cpu_eval(ctx), T.DOUBLE)
+        b = cast_cpu(self.right.cpu_eval(ctx), T.DOUBLE)
+        with np.errstate(all="ignore"):
+            data = np.power(a.values, b.values)
+        return CpuVal(T.DOUBLE, data, a.validity & b.validity)
+
+
+class Round(UnaryExpression):
+    """round(x, scale) with HALF_UP semantics (Spark default)."""
+
+    def __init__(self, child, scale: int = 0):
+        self.scale = int(scale)
+        super().__init__(child)
+
+    def with_children(self, children):
+        return Round(children[0], self.scale)
+
+    def _resolve_type(self):
+        self.dtype = self.child.dtype if self.child.dtype.is_numeric else T.DOUBLE
+        self.nullable = self.child.nullable
+
+    def tpu_eval(self, ctx) -> DevVal:
+        v = self.child.tpu_eval(ctx)
+        if v.dtype.is_integral and self.scale >= 0:
+            return v
+        x = v.data.astype(jnp.float64)
+        m = 10.0 ** self.scale
+        # HALF_UP: round(|x|*m + 0.5) with sign restored (numpy rounds half-even).
+        r = jnp.sign(x) * jnp.floor(jnp.abs(x) * m + 0.5) / m
+        return DevVal(self.dtype, r.astype(self.dtype.jnp_dtype), v.validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        v = self.child.cpu_eval(ctx)
+        if v.dtype.is_integral and self.scale >= 0:
+            return v
+        x = v.values.astype(np.float64)
+        m = 10.0 ** self.scale
+        with np.errstate(all="ignore"):
+            r = np.sign(x) * np.floor(np.abs(x) * m + 0.5) / m
+        return CpuVal(self.dtype, r.astype(self.dtype.np_dtype), v.validity)

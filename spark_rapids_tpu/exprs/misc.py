@@ -1,0 +1,98 @@
+"""Nondeterministic and internal expressions (reference:
+GpuRandomExpressions.scala, GpuMonotonicallyIncreasingID.scala,
+GpuSparkPartitionID.scala, NormalizeFloatingNumbers.scala)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exprs.base import CpuVal, DevVal, Expression, UnaryExpression
+
+
+class MonotonicallyIncreasingID(Expression):
+    """(partition_id << 33) + row offset within partition."""
+
+    def __init__(self):
+        self.children = ()
+        self.dtype = T.LONG
+        self.nullable = False
+
+    def with_children(self, children):
+        return self
+
+    def tpu_eval(self, ctx) -> DevVal:
+        base = (jnp.int64(ctx.partition_index) << 33) + ctx.base_row_id
+        data = base + jnp.arange(ctx.capacity, dtype=jnp.int64)
+        return DevVal(T.LONG, data, jnp.ones(ctx.capacity, dtype=jnp.bool_))
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        base = (np.int64(ctx.partition_index) << np.int64(33)) + ctx.base_row_id
+        data = base + np.arange(ctx.num_rows, dtype=np.int64)
+        return CpuVal(T.LONG, data, np.ones(ctx.num_rows, dtype=np.bool_))
+
+
+class SparkPartitionID(Expression):
+    def __init__(self):
+        self.children = ()
+        self.dtype = T.INT
+        self.nullable = False
+
+    def with_children(self, children):
+        return self
+
+    def tpu_eval(self, ctx) -> DevVal:
+        data = jnp.full(ctx.capacity, ctx.partition_index, dtype=jnp.int32)
+        return DevVal(T.INT, data, jnp.ones(ctx.capacity, dtype=jnp.bool_))
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        data = np.full(ctx.num_rows, ctx.partition_index, dtype=np.int32)
+        return CpuVal(T.INT, data, np.ones(ctx.num_rows, dtype=np.bool_))
+
+
+class Rand(Expression):
+    """Uniform [0,1) per row.  Nondeterministic: TPU uses jax PRNG keyed by
+    (seed, partition, base row id) — results differ from Spark CPU's XORShift
+    but are deterministic per plan execution (the reference flags GpuRand as
+    'retries are not idempotent')."""
+
+    def __init__(self, seed: int = 0):
+        self.children = ()
+        self.seed = int(seed)
+        self.dtype = T.DOUBLE
+        self.nullable = False
+
+    def with_children(self, children):
+        return self
+
+    def tpu_eval(self, ctx) -> DevVal:
+        key = jax.random.PRNGKey(self.seed + 1000003 * (ctx.partition_index + 1))
+        key = jax.random.fold_in(key, ctx.base_row_id.astype(jnp.uint32))
+        data = jax.random.uniform(key, (ctx.capacity,), dtype=jnp.float64)
+        return DevVal(T.DOUBLE, data, jnp.ones(ctx.capacity, dtype=jnp.bool_))
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        rng = np.random.RandomState(
+            (self.seed + 1000003 * (ctx.partition_index + 1)
+             + 31 * int(ctx.base_row_id)) % (2 ** 31))
+        data = rng.uniform(size=ctx.num_rows)
+        return CpuVal(T.DOUBLE, data, np.ones(ctx.num_rows, dtype=np.bool_))
+
+
+class KnownFloatingPointNormalized(UnaryExpression):
+    """Normalize -0.0 -> 0.0 and NaN -> canonical NaN for float grouping keys
+    (reference: NormalizeFloatingNumbers.scala)."""
+
+    def tpu_eval(self, ctx) -> DevVal:
+        v = self.child.tpu_eval(ctx)
+        data = jnp.where(v.data == 0, jnp.zeros_like(v.data), v.data)
+        data = jnp.where(jnp.isnan(data), jnp.full_like(data, jnp.nan), data)
+        return DevVal(v.dtype, data, v.validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        v = self.child.cpu_eval(ctx)
+        data = np.where(v.values == 0, np.zeros_like(v.values), v.values)
+        data = np.where(np.isnan(data), np.full_like(data, np.nan), data)
+        return CpuVal(v.dtype, data, v.validity)

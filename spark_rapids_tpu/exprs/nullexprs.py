@@ -1,0 +1,130 @@
+"""NULL-handling expressions (reference: nullExpressions.scala, 297 LoC)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exprs.base import (
+    CpuVal, DevVal, Expression, UnaryExpression, cast_cpu, cast_dev,
+)
+
+
+class IsNull(UnaryExpression):
+    def _resolve_type(self):
+        self.dtype = T.BOOLEAN
+        self.nullable = False
+
+    def tpu_eval(self, ctx) -> DevVal:
+        v = self.child.tpu_eval(ctx)
+        return DevVal(T.BOOLEAN, ~v.validity, jnp.ones_like(v.validity))
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        v = self.child.cpu_eval(ctx)
+        return CpuVal(T.BOOLEAN, ~v.validity, np.ones(len(v.validity), np.bool_))
+
+
+class IsNotNull(UnaryExpression):
+    def _resolve_type(self):
+        self.dtype = T.BOOLEAN
+        self.nullable = False
+
+    def tpu_eval(self, ctx) -> DevVal:
+        v = self.child.tpu_eval(ctx)
+        return DevVal(T.BOOLEAN, v.validity, jnp.ones_like(v.validity))
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        v = self.child.cpu_eval(ctx)
+        return CpuVal(T.BOOLEAN, v.validity.copy(),
+                      np.ones(len(v.validity), np.bool_))
+
+
+class IsNan(UnaryExpression):
+    def _resolve_type(self):
+        self.dtype = T.BOOLEAN
+        self.nullable = False
+
+    def tpu_eval(self, ctx) -> DevVal:
+        v = self.child.tpu_eval(ctx)
+        data = jnp.isnan(v.data) & v.validity
+        return DevVal(T.BOOLEAN, data, jnp.ones_like(v.validity))
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        v = self.child.cpu_eval(ctx)
+        data = np.isnan(v.values.astype(np.float64)) & v.validity
+        return CpuVal(T.BOOLEAN, data, np.ones(len(v.validity), np.bool_))
+
+
+class Coalesce(Expression):
+    def __init__(self, *children: Expression):
+        assert children
+        self.children = tuple(children)
+        self.dtype = children[0].dtype
+        for c in children[1:]:
+            self.dtype = T.promote(self.dtype, c.dtype)
+        self.nullable = all(c.nullable for c in children)
+
+    def with_children(self, children):
+        return Coalesce(*children)
+
+    def tpu_supported(self, conf):
+        if self.dtype.is_string:
+            return "coalesce over strings not yet supported on TPU"
+        return None
+
+    def tpu_eval(self, ctx) -> DevVal:
+        acc = cast_dev(self.children[0].tpu_eval(ctx), self.dtype)
+        data, validity = acc.data, acc.validity
+        for c in self.children[1:]:
+            v = cast_dev(c.tpu_eval(ctx), self.dtype)
+            data = jnp.where(validity, data, v.data)
+            validity = validity | v.validity
+        return DevVal(self.dtype, data, validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        acc = self.children[0].cpu_eval(ctx)
+        if self.dtype.is_string:
+            values = acc.values.copy()
+            validity = acc.validity.copy()
+            for c in self.children[1:]:
+                v = c.cpu_eval(ctx)
+                take = ~validity & v.validity
+                values[take] = v.values[take]
+                validity |= v.validity
+            return CpuVal(self.dtype, values, validity)
+        acc = cast_cpu(acc, self.dtype)
+        data, validity = acc.values.copy(), acc.validity.copy()
+        for c in self.children[1:]:
+            v = cast_cpu(c.cpu_eval(ctx), self.dtype)
+            data = np.where(validity, data, v.values)
+            validity = validity | v.validity
+        return CpuVal(self.dtype, data.astype(self.dtype.np_dtype), validity)
+
+
+class NaNvl(Expression):
+    """nanvl(a, b): b where a is NaN else a."""
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+        self.dtype = T.DOUBLE
+        self.nullable = left.nullable or right.nullable
+
+    def with_children(self, children):
+        return NaNvl(*children)
+
+    def tpu_eval(self, ctx) -> DevVal:
+        a = cast_dev(self.children[0].tpu_eval(ctx), T.DOUBLE)
+        b = cast_dev(self.children[1].tpu_eval(ctx), T.DOUBLE)
+        nan = jnp.isnan(a.data)
+        data = jnp.where(nan, b.data, a.data)
+        validity = jnp.where(nan, b.validity, a.validity)
+        return DevVal(T.DOUBLE, data, validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        a = cast_cpu(self.children[0].cpu_eval(ctx), T.DOUBLE)
+        b = cast_cpu(self.children[1].cpu_eval(ctx), T.DOUBLE)
+        nan = np.isnan(a.values)
+        data = np.where(nan, b.values, a.values)
+        validity = np.where(nan, b.validity, a.validity)
+        return CpuVal(T.DOUBLE, data, validity.astype(np.bool_))

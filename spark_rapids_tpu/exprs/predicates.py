@@ -1,0 +1,231 @@
+"""Comparison and boolean predicates (reference: predicates.scala, 631 LoC).
+
+And/Or implement Kleene three-valued logic exactly as Spark does.  String
+equality is evaluated on device via dual 64-bit polynomial hashes plus length
+(config spark.rapids.sql.stringHashGroupJoin.enabled); ordering comparisons on
+strings fall back to CPU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exprs.base import (
+    BinaryExpression, CpuVal, DevVal, Expression, Literal, UnaryExpression,
+    promote_cpu, promote_dev,
+)
+
+
+def _string_eq_dev(a: DevVal, b: DevVal):
+    from spark_rapids_tpu.exprs.strings import string_hash2, string_lengths
+    ha1, ha2 = string_hash2(a)
+    hb1, hb2 = string_hash2(b)
+    la, lb = string_lengths(a), string_lengths(b)
+    return (ha1 == hb1) & (ha2 == hb2) & (la == lb)
+
+
+class _Comparison(BinaryExpression):
+    def _resolve_type(self):
+        self.dtype = T.BOOLEAN
+        self.nullable = self.left.nullable or self.right.nullable
+
+    def _compute(self, x, y):
+        raise NotImplementedError
+
+    def _supports_string(self) -> bool:
+        return False
+
+    def tpu_supported(self, conf):
+        if self.left.dtype.is_string or self.right.dtype.is_string:
+            if not self._supports_string():
+                return "string ordering comparisons not supported on TPU"
+        return None
+
+    def tpu_eval(self, ctx) -> DevVal:
+        lv, rv = self.left.tpu_eval(ctx), self.right.tpu_eval(ctx)
+        if lv.dtype.is_string:
+            data = self._compute_string_dev(lv, rv)
+            return DevVal(T.BOOLEAN, data, lv.validity & rv.validity)
+        a, b, _ = promote_dev(lv, rv)
+        return DevVal(T.BOOLEAN, self._compute(a.data, b.data),
+                      a.validity & b.validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        lv, rv = self.left.cpu_eval(ctx), self.right.cpu_eval(ctx)
+        if lv.dtype.is_string:
+            x = np.array([str(v) for v in lv.values], dtype=object)
+            y = np.array([str(v) for v in rv.values], dtype=object)
+            data = self._compute(x, y)
+            return CpuVal(T.BOOLEAN, np.asarray(data, dtype=np.bool_),
+                          lv.validity & rv.validity)
+        a, b, _ = promote_cpu(lv, rv)
+        return CpuVal(T.BOOLEAN, np.asarray(self._compute(a.values, b.values),
+                                            dtype=np.bool_),
+                      a.validity & b.validity)
+
+    def _compute_string_dev(self, a: DevVal, b: DevVal):
+        raise NotImplementedError
+
+
+class Equals(_Comparison):
+    def _supports_string(self):
+        return True
+
+    def _compute(self, x, y):
+        return x == y
+
+    def _compute_string_dev(self, a, b):
+        return _string_eq_dev(a, b)
+
+
+class NotEquals(_Comparison):
+    def _supports_string(self):
+        return True
+
+    def _compute(self, x, y):
+        return x != y
+
+    def _compute_string_dev(self, a, b):
+        return ~_string_eq_dev(a, b)
+
+
+class LessThan(_Comparison):
+    def _compute(self, x, y):
+        return x < y
+
+
+class LessThanOrEqual(_Comparison):
+    def _compute(self, x, y):
+        return x <= y
+
+
+class GreaterThan(_Comparison):
+    def _compute(self, x, y):
+        return x > y
+
+
+class GreaterThanOrEqual(_Comparison):
+    def _compute(self, x, y):
+        return x >= y
+
+
+class EqualNullSafe(BinaryExpression):
+    """<=> : never NULL; NULL <=> NULL is true."""
+
+    def _resolve_type(self):
+        self.dtype = T.BOOLEAN
+        self.nullable = False
+
+    def tpu_supported(self, conf):
+        return None
+
+    def tpu_eval(self, ctx) -> DevVal:
+        lv, rv = self.left.tpu_eval(ctx), self.right.tpu_eval(ctx)
+        if lv.dtype.is_string:
+            eq = _string_eq_dev(lv, rv)
+        else:
+            a, b, _ = promote_dev(lv, rv)
+            eq = a.data == b.data
+            lv, rv = a, b
+        both_null = ~lv.validity & ~rv.validity
+        data = jnp.where(both_null, True, eq & lv.validity & rv.validity)
+        return DevVal(T.BOOLEAN, data, jnp.ones_like(data, dtype=jnp.bool_))
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        lv, rv = self.left.cpu_eval(ctx), self.right.cpu_eval(ctx)
+        if lv.dtype.is_string:
+            eq = np.array([str(a) == str(b) for a, b in zip(lv.values, rv.values)],
+                          dtype=np.bool_)
+        else:
+            a, b, _ = promote_cpu(lv, rv)
+            eq = a.values == b.values
+        both_null = ~lv.validity & ~rv.validity
+        data = np.where(both_null, True, eq & lv.validity & rv.validity)
+        return CpuVal(T.BOOLEAN, data.astype(np.bool_),
+                      np.ones(len(data), dtype=np.bool_))
+
+
+class And(BinaryExpression):
+    def _resolve_type(self):
+        self.dtype = T.BOOLEAN
+        self.nullable = self.left.nullable or self.right.nullable
+
+    def tpu_eval(self, ctx) -> DevVal:
+        a, b = self.left.tpu_eval(ctx), self.right.tpu_eval(ctx)
+        x = a.data & a.validity  # treat NULL as "not definitely true"
+        y = b.data & b.validity
+        false_a = a.validity & ~a.data
+        false_b = b.validity & ~b.data
+        validity = (a.validity & b.validity) | false_a | false_b
+        return DevVal(T.BOOLEAN, x & y, validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        a, b = self.left.cpu_eval(ctx), self.right.cpu_eval(ctx)
+        x = a.values.astype(np.bool_) & a.validity
+        y = b.values.astype(np.bool_) & b.validity
+        false_a = a.validity & ~a.values.astype(np.bool_)
+        false_b = b.validity & ~b.values.astype(np.bool_)
+        validity = (a.validity & b.validity) | false_a | false_b
+        return CpuVal(T.BOOLEAN, x & y, validity)
+
+
+class Or(BinaryExpression):
+    def _resolve_type(self):
+        self.dtype = T.BOOLEAN
+        self.nullable = self.left.nullable or self.right.nullable
+
+    def tpu_eval(self, ctx) -> DevVal:
+        a, b = self.left.tpu_eval(ctx), self.right.tpu_eval(ctx)
+        true_a = a.validity & a.data
+        true_b = b.validity & b.data
+        validity = (a.validity & b.validity) | true_a | true_b
+        return DevVal(T.BOOLEAN, true_a | true_b, validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        a, b = self.left.cpu_eval(ctx), self.right.cpu_eval(ctx)
+        true_a = a.validity & a.values.astype(np.bool_)
+        true_b = b.validity & b.values.astype(np.bool_)
+        validity = (a.validity & b.validity) | true_a | true_b
+        return CpuVal(T.BOOLEAN, true_a | true_b, validity)
+
+
+class Not(UnaryExpression):
+    def _resolve_type(self):
+        self.dtype = T.BOOLEAN
+        self.nullable = self.child.nullable
+
+    def tpu_eval(self, ctx) -> DevVal:
+        v = self.child.tpu_eval(ctx)
+        return DevVal(T.BOOLEAN, ~v.data.astype(jnp.bool_), v.validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        v = self.child.cpu_eval(ctx)
+        return CpuVal(T.BOOLEAN, ~v.values.astype(np.bool_), v.validity)
+
+
+class In(Expression):
+    """value IN (literals...) — OR of equality tests (GpuInSet analogue)."""
+
+    def __init__(self, value: Expression, options):
+        opts = tuple(o if isinstance(o, Expression) else Literal(o) for o in options)
+        self.children = (value,) + opts
+        self.dtype = T.BOOLEAN
+        self.nullable = value.nullable
+
+    def with_children(self, children):
+        return In(children[0], children[1:])
+
+    def _as_or(self) -> Expression:
+        value = self.children[0]
+        expr: Expression = Equals(value, self.children[1])
+        for opt in self.children[2:]:
+            expr = Or(expr, Equals(value, opt))
+        return expr
+
+    def tpu_eval(self, ctx):
+        return self._as_or().tpu_eval(ctx)
+
+    def cpu_eval(self, ctx):
+        return self._as_or().cpu_eval(ctx)

@@ -1,0 +1,769 @@
+"""String expressions on the TPU (reference: stringFunctions.scala, 862 LoC).
+
+Device layout is cudf-style: ``offsets`` int32[cap+1] into a flat uint8 byte
+buffer.  Every kernel below is built from three vectorizable primitives that
+XLA lowers well:
+
+* ``rows_of_positions`` — map each byte position to its owning row
+  (one ``searchsorted`` over the offsets), turning per-row varlen work into
+  flat elementwise work over the byte buffer;
+* prefix sums (``cumsum``) to build output offsets from per-row lengths;
+* gathers with clamped indices to materialize output bytes.
+
+Row equality/grouping uses dual 64-bit polynomial hashes computed with a
+weighted segment-sum over the byte buffer — O(byte_cap) work, no per-row
+loops, no dynamic shapes.
+
+Case mapping is ASCII-only (flagged incompat, like the reference's
+string incompatibilities).  Patterns (needles) must be literals for device
+execution; anything else falls back to CPU via the planner.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exprs.base import (
+    CpuVal, DevVal, Expression, Literal, UnaryExpression,
+)
+
+# ---------------------------------------------------------------------------
+# Primitives
+# ---------------------------------------------------------------------------
+
+
+def string_lengths(v: DevVal):
+    return (v.offsets[1:] - v.offsets[:-1]).astype(jnp.int32)
+
+
+def rows_of_positions(offsets, nbytes: int):
+    """int32[nbytes]: owning row of each byte position (cap for padding)."""
+    pos = jnp.arange(nbytes, dtype=jnp.int32)
+    return jnp.searchsorted(offsets[1:], pos, side="right").astype(jnp.int32)
+
+
+_HASH_BASES = (31, 131)
+
+
+def _pow_table(base: int, n: int):
+    return jnp.concatenate([
+        jnp.ones(1, dtype=jnp.uint64),
+        jnp.cumprod(jnp.full(n, base, dtype=jnp.uint64)),
+    ])
+
+
+def string_hash2(v: DevVal) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Dual 64-bit polynomial row hashes: h = sum byte[i] * base^(end-1-i)."""
+    cap = v.capacity
+    nbytes = int(v.data.shape[0])
+    rows = rows_of_positions(v.offsets, nbytes)
+    rows_c = jnp.clip(rows, 0, cap - 1)
+    ends = v.offsets[rows_c + 1].astype(jnp.int64)
+    pos = jnp.arange(nbytes, dtype=jnp.int64)
+    in_data = pos < v.offsets[-1].astype(jnp.int64)
+    exp = jnp.clip(ends - 1 - pos, 0, nbytes).astype(jnp.int32)
+    byte = jnp.where(in_data, v.data, 0).astype(jnp.uint64)
+    out = []
+    for base in _HASH_BASES:
+        pows = _pow_table(base, nbytes)
+        contrib = byte * pows[exp]
+        h = jax.ops.segment_sum(jnp.where(in_data, contrib, 0), rows_c,
+                                num_segments=cap)
+        # Mix in length so "" vs padding rows differ and lengths disambiguate.
+        h = h + string_lengths(v).astype(jnp.uint64) * jnp.uint64(0x9E3779B97F4A7C15)
+        out.append(h)
+    return out[0], out[1]
+
+
+def hash_literal2(s: str) -> Tuple[int, int]:
+    raw = s.encode("utf-8")
+    out = []
+    for base in _HASH_BASES:
+        h = 0
+        for b in raw:
+            h = (h * base + b) % (1 << 64)
+        h = (h + len(raw) * 0x9E3779B97F4A7C15) % (1 << 64)
+        out.append(h)
+    return out[0], out[1]
+
+
+def build_string(dtype, new_lens, src_index_fn, out_byte_cap: int,
+                 validity) -> DevVal:
+    """Materialize a string column from per-row output lengths.
+
+    ``src_index_fn(row, pos_in_row)`` returns the source byte index for each
+    output byte (vectorized over flat arrays).
+    """
+    cap = int(new_lens.shape[0])
+    new_lens = new_lens.astype(jnp.int32)
+    offsets = jnp.concatenate([
+        jnp.zeros(1, dtype=jnp.int32), jnp.cumsum(new_lens).astype(jnp.int32)
+    ])
+    rows = rows_of_positions(offsets, out_byte_cap)
+    rows_c = jnp.clip(rows, 0, cap - 1)
+    pos_in_row = jnp.arange(out_byte_cap, dtype=jnp.int32) - offsets[rows_c]
+    live = jnp.arange(out_byte_cap, dtype=jnp.int32) < offsets[-1]
+    data = src_index_fn(rows_c, pos_in_row)
+    data = jnp.where(live, data, 0).astype(jnp.uint8)
+    return DevVal(dtype, data, validity, offsets)
+
+
+def _gather_substring(v: DevVal, starts, new_lens, out_byte_cap: int,
+                      validity) -> DevVal:
+    """Common shape: every output row is a contiguous slice of its input row."""
+    src_base = v.offsets[:-1] + starts.astype(jnp.int32)
+    nbytes = int(v.data.shape[0])
+
+    def src(rows, pos):
+        idx = jnp.clip(src_base[rows] + pos, 0, nbytes - 1)
+        return v.data[idx]
+
+    return build_string(T.STRING, new_lens, src, out_byte_cap, validity)
+
+
+def _find_matches(v: DevVal, needle: bytes):
+    """bool[nbytes]: needle match beginning at each byte position, fully
+    inside the owning row."""
+    nbytes = int(v.data.shape[0])
+    L = len(needle)
+    if L == 0:
+        return jnp.ones(nbytes, dtype=jnp.bool_)
+    cap = v.capacity
+    rows = rows_of_positions(v.offsets, nbytes)
+    rows_c = jnp.clip(rows, 0, cap - 1)
+    ends = v.offsets[rows_c + 1]
+    pos = jnp.arange(nbytes, dtype=jnp.int32)
+    ok = (pos + L) <= ends
+    match = ok
+    for k, b in enumerate(needle):
+        idx = jnp.clip(pos + k, 0, nbytes - 1)
+        match = match & (v.data[idx] == np.uint8(b))
+    return match
+
+
+def _rows_with_match(v: DevVal, needle: bytes):
+    cap = v.capacity
+    match = _find_matches(v, needle)
+    nbytes = int(v.data.shape[0])
+    rows = jnp.clip(rows_of_positions(v.offsets, nbytes), 0, cap - 1)
+    counts = jax.ops.segment_sum(match.astype(jnp.int32), rows, num_segments=cap)
+    has = counts > 0
+    if len(needle) == 0:
+        has = jnp.ones(cap, dtype=jnp.bool_)
+    return has
+
+
+def _literal_needle(expr: Expression) -> Optional[str]:
+    if isinstance(expr, Literal) and expr.value is not None:
+        return str(expr.value)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Expressions
+# ---------------------------------------------------------------------------
+
+
+class Length(UnaryExpression):
+    def _resolve_type(self):
+        self.dtype = T.INT
+        self.nullable = self.child.nullable
+
+    def tpu_eval(self, ctx) -> DevVal:
+        v = self.child.tpu_eval(ctx)
+        # NOTE: byte length == char length only for ASCII; Spark counts chars.
+        return DevVal(T.INT, string_lengths(v), v.validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        v = self.child.cpu_eval(ctx)
+        data = np.fromiter((len(str(s)) for s in v.values), dtype=np.int32,
+                           count=len(v.values))
+        return CpuVal(T.INT, data, v.validity)
+
+
+class _CaseMap(UnaryExpression):
+    _delta = 0
+
+    def _resolve_type(self):
+        self.dtype = T.STRING
+        self.nullable = self.child.nullable
+
+    def _map_dev(self, data):
+        raise NotImplementedError
+
+    def _map_cpu(self, s: str) -> str:
+        raise NotImplementedError
+
+    def tpu_eval(self, ctx) -> DevVal:
+        v = self.child.tpu_eval(ctx)
+        return DevVal(T.STRING, self._map_dev(v.data), v.validity, v.offsets)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        v = self.child.cpu_eval(ctx)
+        out = np.array([self._map_cpu(str(s)) for s in v.values], dtype=object)
+        return CpuVal(T.STRING, out, v.validity)
+
+
+class Upper(_CaseMap):
+    def _map_dev(self, data):
+        is_lower = (data >= 97) & (data <= 122)
+        return jnp.where(is_lower, data - 32, data).astype(jnp.uint8)
+
+    def _map_cpu(self, s):
+        return "".join(c.upper() if "a" <= c <= "z" else c for c in s)
+
+
+class Lower(_CaseMap):
+    def _map_dev(self, data):
+        is_upper = (data >= 65) & (data <= 90)
+        return jnp.where(is_upper, data + 32, data).astype(jnp.uint8)
+
+    def _map_cpu(self, s):
+        return "".join(c.lower() if "A" <= c <= "Z" else c for c in s)
+
+
+def _substr_bounds(length, pos: int, sublen: Optional[int], xp):
+    """Spark substring semantics (UTF8String.substringSQL): 1-based pos,
+    negative counts from end; the length window is measured from the raw
+    (possibly negative) start before clamping."""
+    if pos > 0:
+        start_raw = xp.full_like(length, pos - 1)
+    elif pos == 0:
+        start_raw = xp.zeros_like(length)
+    else:
+        start_raw = length + pos
+    end_raw = length if sublen is None else start_raw + max(sublen, 0)
+    start = xp.clip(start_raw, 0, length)
+    end = xp.clip(end_raw, 0, length)
+    n = xp.maximum(end - start, 0)
+    return start.astype(xp.int32), n.astype(xp.int32)
+
+
+class Substring(UnaryExpression):
+    def __init__(self, child: Expression, pos: int, length: Optional[int] = None):
+        self.pos = int(pos)
+        self.sublen = None if length is None else int(length)
+        super().__init__(child)
+
+    def with_children(self, children):
+        return Substring(children[0], self.pos, self.sublen)
+
+    def _resolve_type(self):
+        self.dtype = T.STRING
+        self.nullable = self.child.nullable
+
+    def tpu_eval(self, ctx) -> DevVal:
+        v = self.child.tpu_eval(ctx)
+        lens = string_lengths(v)
+        start, n = _substr_bounds(lens, self.pos, self.sublen, jnp)
+        n = jnp.where(v.validity & ctx.row_mask, n, 0)
+        return _gather_substring(v, start, n, int(v.data.shape[0]), v.validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        v = self.child.cpu_eval(ctx)
+        out = np.empty(len(v.values), dtype=object)
+        for i, s in enumerate(v.values):
+            s = str(s)
+            L = len(s)
+            if self.pos > 0:
+                start_raw = self.pos - 1
+            elif self.pos == 0:
+                start_raw = 0
+            else:
+                start_raw = L + self.pos
+            end_raw = L if self.sublen is None else start_raw + max(self.sublen, 0)
+            start = min(max(start_raw, 0), L)
+            end = min(max(end_raw, 0), L)
+            out[i] = s[start:end]
+        return CpuVal(T.STRING, out, v.validity)
+
+
+class _Trim(UnaryExpression):
+    _left = True
+    _right = True
+
+    def _resolve_type(self):
+        self.dtype = T.STRING
+        self.nullable = self.child.nullable
+
+    def tpu_eval(self, ctx) -> DevVal:
+        v = self.child.tpu_eval(ctx)
+        cap = v.capacity
+        nbytes = int(v.data.shape[0])
+        lens = string_lengths(v)
+        rows = jnp.clip(rows_of_positions(v.offsets, nbytes), 0, cap - 1)
+        pos_in_row = jnp.arange(nbytes, dtype=jnp.int32) - v.offsets[rows]
+        in_data = jnp.arange(nbytes, dtype=jnp.int32) < v.offsets[-1]
+        is_space = (v.data == 32) & in_data
+        big = jnp.int32(nbytes + 1)
+        if self._left:
+            first_ns = jax.ops.segment_min(
+                jnp.where(~is_space & in_data, pos_in_row, big), rows,
+                num_segments=cap)
+            lead = jnp.where(first_ns > lens, lens, first_ns.astype(jnp.int32))
+        else:
+            lead = jnp.zeros(cap, dtype=jnp.int32)
+        if self._right:
+            last_ns = jax.ops.segment_max(
+                jnp.where(~is_space & in_data, pos_in_row, -1), rows,
+                num_segments=cap)
+            trail = lens - 1 - last_ns.astype(jnp.int32)
+            trail = jnp.clip(trail, 0, lens)
+        else:
+            trail = jnp.zeros(cap, dtype=jnp.int32)
+        new_lens = jnp.maximum(lens - lead - trail, 0)
+        new_lens = jnp.where(v.validity & ctx.row_mask, new_lens, 0)
+        return _gather_substring(v, lead, new_lens, nbytes, v.validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        v = self.child.cpu_eval(ctx)
+        out = np.empty(len(v.values), dtype=object)
+        for i, s in enumerate(v.values):
+            s = str(s)
+            if self._left and self._right:
+                out[i] = s.strip(" ")
+            elif self._left:
+                out[i] = s.lstrip(" ")
+            else:
+                out[i] = s.rstrip(" ")
+        return CpuVal(T.STRING, out, v.validity)
+
+
+class StringTrim(_Trim):
+    _left = True
+    _right = True
+
+
+class StringTrimLeft(_Trim):
+    _left = True
+    _right = False
+
+
+class StringTrimRight(_Trim):
+    _left = False
+    _right = True
+
+
+class ConcatStrings(Expression):
+    """concat(a, b, ...) over strings; NULL if any input is NULL (Spark)."""
+
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+        self.dtype = T.STRING
+        self.nullable = any(c.nullable for c in children)
+
+    def with_children(self, children):
+        return ConcatStrings(*children)
+
+    def tpu_eval(self, ctx) -> DevVal:
+        vals = [c.tpu_eval(ctx) for c in self.children]
+        acc = vals[0]
+        for v in vals[1:]:
+            acc = _concat2(acc, v, ctx)
+        return acc
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        vals = [c.cpu_eval(ctx) for c in self.children]
+        n = ctx.num_rows
+        out = np.empty(n, dtype=object)
+        validity = np.ones(n, dtype=np.bool_)
+        for v in vals:
+            validity &= v.validity
+        for i in range(n):
+            out[i] = "".join(str(v.values[i]) for v in vals) if validity[i] else ""
+        return CpuVal(T.STRING, out, validity)
+
+
+def _concat2(a: DevVal, b: DevVal, ctx) -> DevVal:
+    la, lb = string_lengths(a), string_lengths(b)
+    validity = a.validity & b.validity
+    new_lens = jnp.where(validity & ctx.row_mask, la + lb, 0)
+    na, nb = int(a.data.shape[0]), int(b.data.shape[0])
+    a_base, b_base = a.offsets[:-1], b.offsets[:-1]
+
+    def src(rows, pos):
+        from_a = pos < la[rows]
+        ia = jnp.clip(a_base[rows] + pos, 0, na - 1)
+        ib = jnp.clip(b_base[rows] + pos - la[rows], 0, nb - 1)
+        return jnp.where(from_a, a.data[ia], b.data[ib])
+
+    return build_string(T.STRING, new_lens, src, na + nb, validity)
+
+
+class _NeedlePredicate(Expression):
+    """startswith/endswith/contains with a literal needle."""
+
+    def __init__(self, child: Expression, needle: Expression):
+        if not isinstance(needle, Expression):
+            needle = Literal(str(needle), T.STRING)
+        self.children = (child, needle)
+        self.dtype = T.BOOLEAN
+        self.nullable = child.nullable or needle.nullable
+
+    def with_children(self, children):
+        return type(self)(children[0], children[1])
+
+    @property
+    def needle(self) -> Optional[str]:
+        return _literal_needle(self.children[1])
+
+    def tpu_supported(self, conf):
+        if self.needle is None:
+            return "search pattern must be a literal for TPU execution"
+        return None
+
+    def _match_dev(self, v: DevVal, needle: bytes):
+        raise NotImplementedError
+
+    def _match_cpu(self, s: str, needle: str) -> bool:
+        raise NotImplementedError
+
+    def tpu_eval(self, ctx) -> DevVal:
+        v = self.children[0].tpu_eval(ctx)
+        data = self._match_dev(v, self.needle.encode("utf-8"))
+        return DevVal(T.BOOLEAN, data, v.validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        v = self.children[0].cpu_eval(ctx)
+        nv = self.children[1].cpu_eval(ctx)
+        data = np.fromiter(
+            (self._match_cpu(str(s), str(n))
+             for s, n in zip(v.values, nv.values)),
+            dtype=np.bool_, count=len(v.values))
+        return CpuVal(T.BOOLEAN, data, v.validity & nv.validity)
+
+
+def _match_prefix(v: DevVal, needle: bytes):
+    L = len(needle)
+    if L == 0:
+        return jnp.ones(v.capacity, dtype=jnp.bool_)
+    nbytes = int(v.data.shape[0])
+    ok = string_lengths(v) >= L
+    starts = v.offsets[:-1]
+    for k, bch in enumerate(needle):
+        idx = jnp.clip(starts + k, 0, nbytes - 1)
+        ok = ok & (v.data[idx] == np.uint8(bch))
+    return ok
+
+
+def _match_suffix(v: DevVal, needle: bytes):
+    L = len(needle)
+    if L == 0:
+        return jnp.ones(v.capacity, dtype=jnp.bool_)
+    nbytes = int(v.data.shape[0])
+    ok = string_lengths(v) >= L
+    ends = v.offsets[1:]
+    for k, bch in enumerate(needle):
+        idx = jnp.clip(ends - L + k, 0, nbytes - 1)
+        ok = ok & (v.data[idx] == np.uint8(bch))
+    return ok
+
+
+class StringStartsWith(_NeedlePredicate):
+    def _match_dev(self, v, needle):
+        return _match_prefix(v, needle)
+
+    def _match_cpu(self, s, needle):
+        return s.startswith(needle)
+
+
+class StringEndsWith(_NeedlePredicate):
+    def _match_dev(self, v, needle):
+        return _match_suffix(v, needle)
+
+    def _match_cpu(self, s, needle):
+        return s.endswith(needle)
+
+
+class StringContains(_NeedlePredicate):
+    def _match_dev(self, v, needle):
+        return _rows_with_match(v, needle)
+
+    def _match_cpu(self, s, needle):
+        return needle in s
+
+
+class Like(Expression):
+    """SQL LIKE restricted to patterns translatable to prefix/suffix/contains
+    tests: 'abc', 'abc%', '%abc', '%abc%', 'a%b'.  Other patterns (including
+    '_' wildcards and escapes) fall back to CPU."""
+
+    def __init__(self, child: Expression, pattern: str):
+        self.children = (child,)
+        self.pattern = pattern
+        self.dtype = T.BOOLEAN
+        self.nullable = child.nullable
+
+    def with_children(self, children):
+        return Like(children[0], self.pattern)
+
+    def _plan(self):
+        p = self.pattern
+        if "_" in p or "\\" in p:
+            return None
+        parts = p.split("%")
+        if len(parts) == 1:
+            return ("exact", parts[0])
+        if len(parts) == 2:
+            if parts[0] == "" and parts[1] == "":
+                return ("any",)
+            if parts[1] == "":
+                return ("prefix", parts[0])
+            if parts[0] == "":
+                return ("suffix", parts[1])
+            return ("prefix_suffix", parts[0], parts[1])
+        if len(parts) == 3 and parts[0] == "" and parts[2] == "":
+            return ("contains", parts[1])
+        return None
+
+    def tpu_supported(self, conf):
+        if self._plan() is None:
+            return f"LIKE pattern {self.pattern!r} not supported on TPU"
+        return None
+
+    def tpu_eval(self, ctx) -> DevVal:
+        v = self.children[0].tpu_eval(ctx)
+        plan = self._plan()
+        kind = plan[0]
+        lens = string_lengths(v)
+        if kind == "any":
+            data = jnp.ones(v.capacity, dtype=jnp.bool_)
+        elif kind == "exact":
+            h1, h2 = string_hash2(v)
+            e1, e2 = hash_literal2(plan[1])
+            data = (h1 == jnp.uint64(e1)) & (h2 == jnp.uint64(e2))
+        elif kind == "prefix":
+            data = _match_prefix(v, plan[1].encode())
+        elif kind == "suffix":
+            data = _match_suffix(v, plan[1].encode())
+        elif kind == "contains":
+            data = _rows_with_match(v, plan[1].encode())
+        else:  # prefix_suffix
+            pre, suf = plan[1], plan[2]
+            data = (_match_prefix(v, pre.encode())
+                    & _match_suffix(v, suf.encode())
+                    & (lens >= len(pre) + len(suf)))
+        return DevVal(T.BOOLEAN, data, v.validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        import re
+        v = self.children[0].cpu_eval(ctx)
+        regex = "^" + "".join(
+            ".*" if c == "%" else "." if c == "_" else re.escape(c)
+            for c in self.pattern) + "$"
+        rx = re.compile(regex, re.DOTALL)
+        data = np.fromiter((rx.match(str(s)) is not None for s in v.values),
+                           dtype=np.bool_, count=len(v.values))
+        return CpuVal(T.BOOLEAN, data, v.validity)
+
+
+class StringLocate(Expression):
+    """locate(needle, str): 1-based position of first match, 0 if absent."""
+
+    def __init__(self, needle: Expression, child: Expression):
+        if not isinstance(needle, Expression):
+            needle = Literal(str(needle), T.STRING)
+        self.children = (needle, child)
+        self.dtype = T.INT
+        self.nullable = child.nullable
+
+    def with_children(self, children):
+        return StringLocate(children[0], children[1])
+
+    def tpu_supported(self, conf):
+        if _literal_needle(self.children[0]) is None:
+            return "locate needle must be a literal for TPU execution"
+        return None
+
+    def tpu_eval(self, ctx) -> DevVal:
+        v = self.children[1].tpu_eval(ctx)
+        needle = _literal_needle(self.children[0]).encode("utf-8")
+        cap = v.capacity
+        if len(needle) == 0:
+            return DevVal(T.INT, jnp.ones(cap, dtype=jnp.int32), v.validity)
+        nbytes = int(v.data.shape[0])
+        match = _find_matches(v, needle)
+        rows = jnp.clip(rows_of_positions(v.offsets, nbytes), 0, cap - 1)
+        pos_in_row = jnp.arange(nbytes, dtype=jnp.int32) - v.offsets[rows]
+        big = jnp.int32(nbytes + 1)
+        first = jax.ops.segment_min(jnp.where(match, pos_in_row, big), rows,
+                                    num_segments=cap)
+        data = jnp.where(first >= big, 0, first + 1).astype(jnp.int32)
+        return DevVal(T.INT, data, v.validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        v = self.children[1].cpu_eval(ctx)
+        needle = str(_literal_needle(self.children[0]) or "")
+        data = np.fromiter((str(s).find(needle) + 1 for s in v.values),
+                           dtype=np.int32, count=len(v.values))
+        return CpuVal(T.INT, data, v.validity)
+
+
+def _has_self_overlap(needle: bytes) -> bool:
+    """True if the pattern can match at two positions closer than len(needle)."""
+    L = len(needle)
+    for k in range(1, L):
+        if needle[k:] == needle[:-k]:
+            return True
+    return False
+
+
+class StringReplace(Expression):
+    """replace(str, search, replacement) with literal search/replacement."""
+
+    def __init__(self, child: Expression, search: Expression, replacement: Expression):
+        if not isinstance(search, Expression):
+            search = Literal(str(search), T.STRING)
+        if not isinstance(replacement, Expression):
+            replacement = Literal(str(replacement), T.STRING)
+        self.children = (child, search, replacement)
+        self.dtype = T.STRING
+        self.nullable = child.nullable
+
+    def with_children(self, children):
+        return StringReplace(*children)
+
+    def tpu_supported(self, conf):
+        s = _literal_needle(self.children[1])
+        if s is None or _literal_needle(self.children[2]) is None:
+            return "replace search/replacement must be literals for TPU"
+        if s == "":
+            return "replace with empty search is a no-op handled on CPU"
+        if _has_self_overlap(s.encode("utf-8")):
+            return ("replace search pattern can self-overlap; sequential "
+                    "matching required (CPU only)")
+        return None
+
+    def tpu_eval(self, ctx) -> DevVal:
+        v = self.children[0].tpu_eval(ctx)
+        search = _literal_needle(self.children[1]).encode("utf-8")
+        repl = _literal_needle(self.children[2]).encode("utf-8")
+        cap = v.capacity
+        nbytes = int(v.data.shape[0])
+        Ls, Lr = len(search), len(repl)
+        match = _find_matches(v, search)
+        rows = jnp.clip(rows_of_positions(v.offsets, nbytes), 0, cap - 1)
+        n_matches = jax.ops.segment_sum(match.astype(jnp.int32), rows,
+                                        num_segments=cap)
+        lens = string_lengths(v)
+        new_lens = lens + n_matches * (Lr - Ls)
+        new_lens = jnp.where(v.validity & ctx.row_mask, new_lens, 0)
+        out_cap = nbytes if Lr <= Ls else nbytes + (nbytes // Ls) * (Lr - Ls)
+        row_first_byte = v.offsets[rows]
+        pos_in_row = jnp.arange(nbytes, dtype=jnp.int32) - row_first_byte
+        starts_i = match.astype(jnp.int32)
+        # covered[i] = any match start in (i-Ls, i] -> byte i is replaced.
+        csum = jnp.concatenate([jnp.zeros(1, dtype=jnp.int32),
+                                jnp.cumsum(starts_i)])
+        lo = jnp.maximum(jnp.arange(nbytes) - Ls + 1, 0)
+        covered = (csum[jnp.arange(nbytes) + 1] - csum[lo]) > 0
+        # Matches before byte i in the same row:
+        m_before = csum[jnp.arange(nbytes)]  # global matches strictly before i
+        m_before_row_start = csum[jnp.clip(row_first_byte, 0, nbytes)]
+        m_in_row_before = m_before - m_before_row_start
+        # Output position of each *copied* byte and each *match start*:
+        out_pos_copy = pos_in_row + m_in_row_before * (Lr - Ls)
+        # Build output via scatter of copied bytes, then scatter replacement
+        # bytes at match starts.
+        out_offsets = jnp.concatenate([
+            jnp.zeros(1, dtype=jnp.int32),
+            jnp.cumsum(new_lens).astype(jnp.int32)])
+        out_total = out_offsets[-1]
+        out_base = out_offsets[rows]
+        out_idx_copy = out_base + out_pos_copy
+        in_data_mask = jnp.arange(nbytes, dtype=jnp.int32) < v.offsets[-1]
+        valid_copy = in_data_mask & ~covered
+        out = jnp.zeros(out_cap, dtype=jnp.uint8)
+        out = out.at[jnp.where(valid_copy, out_idx_copy, out_cap)].set(
+            v.data, mode="drop")
+        # match starts: the match at input pos i (m_in_row_before matches
+        # before it) maps to output position pos_in_row + m_in_row_before*(Lr-Ls)
+        out_idx_match = out_base + pos_in_row + m_in_row_before * (Lr - Ls)
+        for k, bch in enumerate(repl):
+            out = out.at[jnp.where(match & in_data_mask, out_idx_match + k,
+                                   out_cap)].set(
+                jnp.full(nbytes, bch, dtype=jnp.uint8), mode="drop")
+        return DevVal(T.STRING, out, v.validity, out_offsets)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        v = self.children[0].cpu_eval(ctx)
+        search = str(_literal_needle(self.children[1]) or "")
+        repl = str(_literal_needle(self.children[2]) or "")
+        if search == "":
+            out = np.array([str(s) for s in v.values], dtype=object)
+        else:
+            out = np.array([str(s).replace(search, repl) for s in v.values],
+                           dtype=object)
+        return CpuVal(T.STRING, out, v.validity)
+
+
+class _Pad(Expression):
+    _left = True
+
+    def __init__(self, child: Expression, length: int, pad: str = " "):
+        self.children = (child,)
+        self.target = int(length)
+        self.pad = str(pad)
+        self.dtype = T.STRING
+        self.nullable = child.nullable
+
+    def with_children(self, children):
+        return type(self)(children[0], self.target, self.pad)
+
+    def tpu_supported(self, conf):
+        if len(self.pad) != 1:
+            return "multi-char pad strings not supported on TPU yet"
+        return None
+
+    def tpu_eval(self, ctx) -> DevVal:
+        v = self.children[0].tpu_eval(ctx)
+        cap = v.capacity
+        nbytes = int(v.data.shape[0])
+        lens = string_lengths(v)
+        tgt = jnp.int32(self.target)
+        new_lens = jnp.where(v.validity & ctx.row_mask,
+                             jnp.full(cap, tgt, dtype=jnp.int32), 0)
+        pad_b = np.uint8(ord(self.pad))
+        npad = jnp.maximum(tgt - lens, 0)
+        base = v.offsets[:-1]
+
+        def src_index(rows, pos):
+            if self._left:
+                is_pad = pos < npad[rows]
+                src = base[rows] + pos - npad[rows]
+            else:
+                is_pad = pos >= lens[rows]
+                src = base[rows] + pos
+            byte = v.data[jnp.clip(src, 0, nbytes - 1)]
+            return jnp.where(is_pad, pad_b, byte)
+
+        out_cap = max(cap * max(self.target, 1), 16)
+        return build_string(T.STRING, new_lens, src_index, out_cap, v.validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        v = self.children[0].cpu_eval(ctx)
+        out = np.empty(len(v.values), dtype=object)
+        for i, s in enumerate(v.values):
+            s = str(s)
+            if len(s) >= self.target:
+                out[i] = s[: self.target]
+            elif self._left:
+                out[i] = (self.pad * self.target + s)[-self.target:] \
+                    if self.pad else s
+            else:
+                out[i] = (s + self.pad * self.target)[: self.target] \
+                    if self.pad else s
+        return CpuVal(T.STRING, out, v.validity)
+
+
+class StringLPad(_Pad):
+    _left = True
+
+
+class StringRPad(_Pad):
+    _left = False

@@ -1,0 +1,255 @@
+"""Data type system for the TPU columnar engine.
+
+Mirrors the v0.3 supported-type envelope of the reference
+(GpuOverrides.scala:397-409): boolean, byte, short, int, long, float, double,
+date, timestamp, string.  Each SQL type maps to a dense on-device
+representation chosen for TPU/XLA friendliness:
+
+  - integral/float types -> the matching jnp dtype
+  - boolean              -> jnp.bool_
+  - date                 -> int32 days since epoch
+  - timestamp            -> int64 microseconds since epoch (UTC only, like the
+                            reference: GpuOverrides.scala:309 timezone check)
+  - string               -> offsets(int32[n+1]) + bytes(uint8[byte_cap]),
+                            the cudf-style layout (SURVEY.md section 7)
+
+Null handling: every device column carries a validity mask (bool, True=valid);
+SQL NULL semantics are implemented in the expression kernels, not by sentinel
+values.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class DataType:
+    """Base class for SQL data types."""
+
+    #: jnp dtype of the primary data buffer on device.
+    jnp_dtype: Any = None
+    #: numpy dtype used by the host/CPU-oracle representation.
+    np_dtype: Any = None
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__.replace("Type", "").lower()
+
+    def __repr__(self) -> str:
+        return self.name
+
+    def __eq__(self, other: object) -> bool:
+        return type(self) is type(other)
+
+    def __hash__(self) -> int:
+        return hash(type(self))
+
+    @property
+    def is_numeric(self) -> bool:
+        return isinstance(self, (IntegralType, FractionalType))
+
+    @property
+    def is_integral(self) -> bool:
+        return isinstance(self, IntegralType)
+
+    @property
+    def is_fractional(self) -> bool:
+        return isinstance(self, FractionalType)
+
+    @property
+    def is_string(self) -> bool:
+        return isinstance(self, StringType)
+
+    @property
+    def is_datetime(self) -> bool:
+        return isinstance(self, (DateType, TimestampType))
+
+
+class NumericType(DataType):
+    pass
+
+
+class IntegralType(NumericType):
+    pass
+
+
+class FractionalType(NumericType):
+    pass
+
+
+class BooleanType(DataType):
+    jnp_dtype = jnp.bool_
+    np_dtype = np.bool_
+
+
+class ByteType(IntegralType):
+    jnp_dtype = jnp.int8
+    np_dtype = np.int8
+
+
+class ShortType(IntegralType):
+    jnp_dtype = jnp.int16
+    np_dtype = np.int16
+
+
+class IntegerType(IntegralType):
+    jnp_dtype = jnp.int32
+    np_dtype = np.int32
+
+
+class LongType(IntegralType):
+    jnp_dtype = jnp.int64
+    np_dtype = np.int64
+
+
+class FloatType(FractionalType):
+    jnp_dtype = jnp.float32
+    np_dtype = np.float32
+
+
+class DoubleType(FractionalType):
+    jnp_dtype = jnp.float64
+    np_dtype = np.float64
+
+
+class DateType(DataType):
+    """Days since unix epoch, int32 (matches Spark's internal representation)."""
+
+    jnp_dtype = jnp.int32
+    np_dtype = np.int32
+
+
+class TimestampType(DataType):
+    """Microseconds since unix epoch, int64, UTC only."""
+
+    jnp_dtype = jnp.int64
+    np_dtype = np.int64
+
+
+class StringType(DataType):
+    """Variable-length UTF-8: offsets int32[n+1] + flat uint8 byte buffer."""
+
+    jnp_dtype = jnp.uint8
+    np_dtype = np.object_  # host oracle keeps python str / None
+
+
+class NullType(DataType):
+    """Type of an untyped NULL literal."""
+
+    jnp_dtype = jnp.int32
+    np_dtype = np.int32
+
+
+# Singletons, Spark-style.
+BOOLEAN = BooleanType()
+BYTE = ByteType()
+SHORT = ShortType()
+INT = IntegerType()
+LONG = LongType()
+FLOAT = FloatType()
+DOUBLE = DoubleType()
+DATE = DateType()
+TIMESTAMP = TimestampType()
+STRING = StringType()
+NULL = NullType()
+
+ALL_TYPES = (BOOLEAN, BYTE, SHORT, INT, LONG, FLOAT, DOUBLE, DATE, TIMESTAMP, STRING)
+
+_NAME_TO_TYPE = {t.name: t for t in ALL_TYPES}
+_NAME_TO_TYPE.update({"int": INT, "bigint": LONG, "smallint": SHORT, "tinyint": BYTE})
+
+# Numeric widening lattice for implicit binary-op promotion (Spark semantics).
+_NUMERIC_ORDER = [BYTE, SHORT, INT, LONG, FLOAT, DOUBLE]
+
+
+def type_from_name(name: str) -> DataType:
+    return _NAME_TO_TYPE[name.lower()]
+
+
+def promote(a: DataType, b: DataType) -> DataType:
+    """Common type for a binary numeric operation (Spark's findTightestCommonType)."""
+    if a == b:
+        return a
+    if isinstance(a, NullType):
+        return b
+    if isinstance(b, NullType):
+        return a
+    if a.is_numeric and b.is_numeric:
+        ia, ib = _NUMERIC_ORDER.index(a), _NUMERIC_ORDER.index(b)
+        # long + float -> double to avoid precision loss (Spark behavior is
+        # float, but double is the safe superset; we follow Spark: wider wins).
+        return _NUMERIC_ORDER[max(ia, ib)]
+    raise TypeError(f"no common type for {a} and {b}")
+
+
+def np_scalar(dt: DataType, value: Any):
+    """Convert a python value to the numpy scalar for the host representation."""
+    if value is None:
+        return None
+    if dt.is_string:
+        return str(value)
+    return dt.np_dtype(value)
+
+
+@dataclasses.dataclass(frozen=True)
+class Field:
+    name: str
+    dtype: DataType
+    nullable: bool = True
+
+    def __repr__(self) -> str:
+        n = "" if self.nullable else " not null"
+        return f"{self.name}: {self.dtype}{n}"
+
+
+class Schema:
+    """Ordered collection of named, typed fields."""
+
+    def __init__(self, fields):
+        self.fields: Tuple[Field, ...] = tuple(
+            f if isinstance(f, Field) else Field(*f) for f in fields
+        )
+        self._index = {f.name: i for i, f in enumerate(self.fields)}
+        if len(self._index) != len(self.fields):
+            raise ValueError(f"duplicate column names in schema: {self.fields}")
+
+    @property
+    def names(self):
+        return [f.name for f in self.fields]
+
+    @property
+    def types(self):
+        return [f.dtype for f in self.fields]
+
+    def index_of(self, name: str) -> int:
+        return self._index[name]
+
+    def field(self, name: str) -> Field:
+        return self.fields[self._index[name]]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._index
+
+    def __len__(self) -> int:
+        return len(self.fields)
+
+    def __iter__(self):
+        return iter(self.fields)
+
+    def __getitem__(self, i):
+        if isinstance(i, str):
+            return self.field(i)
+        return self.fields[i]
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Schema) and self.fields == other.fields
+
+    def __hash__(self) -> int:
+        return hash(self.fields)
+
+    def __repr__(self) -> str:
+        return "Schema(" + ", ".join(repr(f) for f in self.fields) + ")"

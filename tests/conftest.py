@@ -1,0 +1,78 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh so multi-chip
+sharding logic is exercised without TPU hardware (SURVEY.md environment notes).
+
+Must configure XLA before jax initializes its backends.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+import numpy as np
+import pytest
+
+# The environment's sitecustomize pins JAX_PLATFORMS to the TPU plugin; the
+# config update (post-import, pre-backend-init) reliably forces CPU for tests.
+jax.config.update("jax_platforms", "cpu")
+
+import spark_rapids_tpu  # noqa: F401  (enables x64)
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)
+
+
+def assert_cols_equal(expected, actual, approx=False, msg=""):
+    """Deep-compare two column value lists (None = NULL)."""
+    assert len(expected) == len(actual), \
+        f"{msg}: row count {len(expected)} != {len(actual)}"
+    for i, (e, a) in enumerate(zip(expected, actual)):
+        if e is None or a is None:
+            assert e is None and a is None, f"{msg} row {i}: {e!r} != {a!r}"
+        elif approx and isinstance(e, float):
+            if e != e:  # NaN
+                assert a != a, f"{msg} row {i}: {e!r} != {a!r}"
+            else:
+                assert a == pytest.approx(e, rel=1e-6, abs=1e-9), \
+                    f"{msg} row {i}: {e!r} != {a!r}"
+        else:
+            assert e == a, f"{msg} row {i}: {e!r} != {a!r}"
+
+
+def assert_batches_equal(expected, actual, approx=False, ignore_order=False):
+    """Compare two HostBatch-like pydicts."""
+    e, a = expected, actual
+    assert set(e.keys()) == set(a.keys()), f"{e.keys()} != {a.keys()}"
+    if ignore_order:
+        def keyed(d):
+            cols = list(d.keys())
+            rows = list(zip(*[d[c] for c in cols]))
+            return sorted(rows, key=lambda r: tuple(
+                (x is None, str(x)) for x in r))
+        er = keyed(e)
+        ar = keyed(a)
+        assert len(er) == len(ar), f"row count {len(er)} != {len(ar)}"
+        for i, (re_, ra) in enumerate(zip(er, ar)):
+            for c, (x, y) in enumerate(zip(re_, ra)):
+                if approx and isinstance(x, float) and x is not None \
+                        and y is not None:
+                    if x != x:
+                        assert y != y
+                    else:
+                        assert y == pytest.approx(x, rel=1e-6, abs=1e-9), \
+                            f"row {i} col {c}: {x!r} != {y!r}"
+                else:
+                    assert (x is None) == (y is None) and (
+                        x is None or x == y or
+                        (approx and isinstance(x, float)
+                         and y == pytest.approx(x, rel=1e-6, abs=1e-9))), \
+                        f"row {i} col {c}: {x!r} != {y!r}"
+    else:
+        for name in e:
+            assert_cols_equal(e[name], a[name], approx=approx, msg=name)
