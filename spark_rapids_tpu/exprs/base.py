@@ -11,6 +11,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -43,6 +44,25 @@ class DevVal:
     @staticmethod
     def from_column(col: DeviceColumn) -> "DevVal":
         return DevVal(col.dtype, col.data, col.validity, col.offsets)
+
+    def tree_flatten(self):
+        if self.offsets is None:
+            return (self.data, self.validity), (self.dtype, False)
+        return (self.data, self.validity, self.offsets), (self.dtype, True)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        dtype, has_offsets = aux
+        if has_offsets:
+            data, validity, offsets = children
+            return cls(dtype, data, validity, offsets)
+        data, validity = children
+        return cls(dtype, data, validity, None)
+
+
+jax.tree_util.register_pytree_node(
+    DevVal, DevVal.tree_flatten, DevVal.tree_unflatten
+)
 
 
 @dataclasses.dataclass

@@ -1,0 +1,27 @@
+"""Static-shape device kernels for the columnar engine.
+
+This package is the TPU analogue of libcudf's kernel surface
+(SURVEY.md section 2.9): everything the reference does through cudf JNI calls
+(filter, orderBy, groupby aggregate, joins, concatenate, partition) is
+implemented here as jit-friendly JAX code over the padded
+:class:`~spark_rapids_tpu.batch.ColumnBatch` layout.
+
+Design rules (see batch.py / SURVEY.md section 7):
+
+* all shapes static at trace time; dynamic row counts are ``num_rows`` scalars
+  plus masks;
+* kernels whose *output* size is data-dependent (join, concat growth) use the
+  two-phase pattern: a jitted sizing pass returns scalar counts, the host
+  buckets them to a power-of-two capacity, and a second jitted pass runs with
+  that static capacity.  The compile cache amortizes this across batches;
+* row movement is always *gather* (never scatter) so XLA can fuse freely.
+"""
+
+from spark_rapids_tpu.kernels.layout import (
+    compact,
+    concat_pair,
+    gather_rows,
+    take_head,
+)
+from spark_rapids_tpu.kernels.sort import argsort_batch, sort_batch
+from spark_rapids_tpu.kernels.sortkeys import encode_sort_keys

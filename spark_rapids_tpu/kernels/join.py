@@ -1,0 +1,281 @@
+"""Equi-join kernels (cudf ``Table.onColumns(...).{inner,left,...}Join``
+analogue, shims/spark300/GpuHashJoin.scala:282-308).
+
+TPU-first design: no hash table.  The build side is *sorted by a 64-bit key
+hash*; each probe row locates its candidate range with two ``searchsorted``
+calls; candidates are verified by exact key comparison.  Output size is
+data-dependent, so the join runs in two phases (SURVEY.md section 7's
+bucketed-padded-batch recipe):
+
+  phase 1 (jit, static shapes): per-probe candidate counts -> total pairs
+           (+ unmatched-row counts for outer joins) -> host reads 3 scalars
+  phase 2 (jit, static output capacity chosen by host): expand the pair list
+           via searchsorted-on-cumsum, verify matches, compact, gather both
+           sides' rows, stitch the output batch.
+
+NULL equi-join keys never match (SQL semantics), including null==null.
+
+Join types: inner, left, right, full, left_semi, left_anti, cross.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.batch import ColumnBatch, round_up_capacity
+from spark_rapids_tpu.exprs.base import DevVal
+from spark_rapids_tpu.kernels.layout import compaction_indices, gather_rows
+
+_GOLD = jnp.uint64(0x9E3779B97F4A7C15)
+
+
+def _key_hash64(vals: List[DevVal]) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(hash u64[cap], all_valid bool[cap]) over the key columns.
+
+    Rows with any NULL key get all_valid=False and a sentinel hash of ~0
+    (sorts last, never matched — SQL null-key semantics).
+    """
+    cap = int(vals[0].validity.shape[0])
+    h = jnp.zeros(cap, dtype=jnp.uint64)
+    ok = jnp.ones(cap, dtype=jnp.bool_)
+    for v in vals:
+        ok = ok & v.validity
+        if v.dtype.is_string:
+            from spark_rapids_tpu.exprs.strings import string_hash2
+            h1, h2 = string_hash2(v)
+            w = h1 ^ (h2 * _GOLD)
+        else:
+            from spark_rapids_tpu.kernels.sortkeys import _encode_fixed
+            w = _encode_fixed(v)
+        h = (h * _GOLD) ^ w ^ (h >> jnp.uint64(31))
+    return jnp.where(ok, h, ~jnp.uint64(0)), ok
+
+
+def _exact_eq(a_vals: List[DevVal], a_idx, b_vals: List[DevVal], b_idx):
+    """Exact key equality for gathered index pairs (both sides valid)."""
+    eq = jnp.ones(a_idx.shape, dtype=jnp.bool_)
+    for va, vb in zip(a_vals, b_vals):
+        eq = eq & va.validity[a_idx] & vb.validity[b_idx]
+        if va.dtype.is_string:
+            from spark_rapids_tpu.exprs.strings import string_hash2
+            la = (va.offsets[1:] - va.offsets[:-1])[a_idx]
+            lb = (vb.offsets[1:] - vb.offsets[:-1])[b_idx]
+            a1, a2 = string_hash2(va)
+            b1, b2 = string_hash2(vb)
+            eq = eq & (la == lb) & (a1[a_idx] == b1[b_idx]) & \
+                (a2[a_idx] == b2[b_idx])
+        else:
+            from spark_rapids_tpu.kernels.sortkeys import _encode_fixed
+            ea, eb = _encode_fixed(va), _encode_fixed(vb)
+            eq = eq & (ea[a_idx] == eb[b_idx])
+    return eq
+
+
+@dataclasses.dataclass
+class JoinSizing:
+    """Host-visible scalars from phase 1 (+ device arrays reused by phase 2)."""
+
+    total_pairs: int
+    probe_cap: int
+    build_cap: int
+
+
+def _phase1(probe_hash, probe_ok, probe_live, build_sorted_hash, build_live_n):
+    lo = jnp.searchsorted(build_sorted_hash, probe_hash, side="left")
+    hi = jnp.searchsorted(build_sorted_hash, probe_hash, side="right")
+    counts = jnp.where(probe_ok & probe_live, hi - lo, 0).astype(jnp.int64)
+    return lo.astype(jnp.int32), counts, jnp.sum(counts)
+
+
+_phase1_jit = jax.jit(_phase1)
+
+
+def _build_sort(build_hash):
+    perm = jnp.argsort(build_hash, stable=True).astype(jnp.int32)
+    return perm, build_hash[perm]
+
+
+_build_sort_jit = jax.jit(_build_sort)
+
+
+def join_pairs(left_keys: List[DevVal], left_num_rows,
+               right_keys: List[DevVal], right_num_rows,
+               pair_cap_hint: Optional[int] = None):
+    """Compute matching (left_idx, right_idx) pair arrays.
+
+    Returns (l_idx i32[pair_cap], r_idx i32[pair_cap], n_pairs i32 scalar,
+    l_match_counts i64[l_cap], r_matched bool[r_cap]).  Pairs are compacted to
+    the front.  Host sync: one scalar read for sizing.
+    """
+    l_cap = int(left_keys[0].validity.shape[0])
+    r_cap = int(right_keys[0].validity.shape[0])
+    l_live = jnp.arange(l_cap, dtype=jnp.int32) < left_num_rows
+    r_live = jnp.arange(r_cap, dtype=jnp.int32) < right_num_rows
+
+    l_hash, l_ok = _key_hash64(left_keys)
+    r_hash, r_ok = _key_hash64(right_keys)
+    r_hash = jnp.where(r_live & r_ok, r_hash, ~jnp.uint64(0))
+    perm, r_sorted = _build_sort_jit(r_hash)
+    # Sentinel rows (~0 hash) are never matched because probe rows with ok
+    # hash ~0 are masked by probe_ok in phase 1.
+    lo, counts, total = _phase1_jit(l_hash, l_ok, l_live, r_sorted,
+                                    right_num_rows)
+
+    total_pairs = int(jax.device_get(total))
+    pair_cap = round_up_capacity(max(total_pairs, 1))
+    if pair_cap_hint is not None:
+        pair_cap = max(pair_cap, pair_cap_hint)
+
+    @jax.jit
+    def phase2(lo, counts, perm, l_keys, r_keys, total):
+        cum = jnp.cumsum(counts)
+        starts = cum - counts
+        k = jnp.arange(pair_cap, dtype=jnp.int64)
+        probe_row = jnp.searchsorted(cum, k, side="right").astype(jnp.int32)
+        probe_row = jnp.clip(probe_row, 0, l_cap - 1)
+        ordinal = (k - starts[probe_row]).astype(jnp.int32)
+        build_pos = jnp.clip(lo[probe_row] + ordinal, 0, r_cap - 1)
+        build_row = perm[build_pos]
+        in_range = k < total
+        match = in_range & _exact_eq(l_keys, probe_row, r_keys, build_row)
+        # compact matches to the front
+        order = jnp.argsort(jnp.where(match, 0, 1), stable=True)
+        n_pairs = jnp.sum(match).astype(jnp.int32)
+        l_idx = probe_row[order]
+        r_idx = build_row[order]
+        # per-left-row match counts + right matched flags (for outer joins)
+        ones = match.astype(jnp.int64)
+        l_counts = jax.ops.segment_sum(ones, probe_row, num_segments=l_cap)
+        r_matched = jax.ops.segment_max(
+            ones, build_row, num_segments=r_cap) > 0
+        return l_idx.astype(jnp.int32), r_idx.astype(jnp.int32), n_pairs, \
+            l_counts, r_matched
+
+    return phase2(lo, counts, perm, left_keys, right_keys, total)
+
+
+def _string_byte_caps(batch: ColumnBatch, indices, live) -> List[int]:
+    """Host-sync sizing of output byte capacities for string columns."""
+    caps = []
+    for c in batch.columns:
+        if c.is_string:
+            lens = (c.offsets[1:] - c.offsets[:-1]).astype(jnp.int64)
+            total = jnp.sum(jnp.where(live, lens[jnp.clip(
+                indices, 0, batch.capacity - 1)], 0))
+            caps.append(round_up_capacity(int(jax.device_get(total)),
+                                          minimum=16))
+    return caps
+
+
+def hash_join(left: ColumnBatch, left_keys: List[DevVal],
+              right: ColumnBatch, right_keys: List[DevVal],
+              join_type: str, out_schema: T.Schema) -> ColumnBatch:
+    """Full equi-join of two batches.  Output columns = left cols ++ right
+    cols (semi/anti: left only), per ``out_schema``."""
+    l_cap, r_cap = left.capacity, right.capacity
+    l_idx, r_idx, n_pairs, l_counts, r_matched = join_pairs(
+        left_keys, left.num_rows, right_keys, right.num_rows)
+    pair_cap = int(l_idx.shape[0])
+    l_live = jnp.arange(l_cap, dtype=jnp.int32) < left.num_rows
+    r_live = jnp.arange(r_cap, dtype=jnp.int32) < right.num_rows
+
+    if join_type in ("left_semi", "left_anti"):
+        if join_type == "left_semi":
+            mask = l_live & (l_counts > 0)
+        else:
+            mask = l_live & (l_counts == 0)
+        idx, count = compaction_indices(mask, left.num_rows)
+        return gather_rows(left, idx, count)
+
+    if join_type == "inner":
+        live = jnp.arange(pair_cap, dtype=jnp.int32) < n_pairs
+        lcaps = _string_byte_caps(left, l_idx, live)
+        rcaps = _string_byte_caps(right, r_idx, live)
+        lg = gather_rows(left, l_idx, n_pairs, out_capacity=pair_cap,
+                         out_byte_caps=lcaps or None)
+        rg = gather_rows(right, r_idx, n_pairs, out_capacity=pair_cap,
+                         out_byte_caps=rcaps or None)
+        return ColumnBatch(out_schema, list(lg.columns) + list(rg.columns),
+                           n_pairs, pair_cap)
+
+    if join_type in ("left", "right", "full"):
+        # Unmatched-left rows (left/full) and unmatched-right rows
+        # (right/full) are appended after the matched pairs with the other
+        # side NULL-padded.
+        add_left = join_type in ("left", "full")
+        add_right = join_type in ("right", "full")
+        un_l_mask = l_live & (l_counts == 0) if add_left else \
+            jnp.zeros(l_cap, dtype=jnp.bool_)
+        un_r_mask = r_live & ~r_matched if add_right else \
+            jnp.zeros(r_cap, dtype=jnp.bool_)
+        n_un_l = jnp.sum(un_l_mask).astype(jnp.int32)
+        n_un_r = jnp.sum(un_r_mask).astype(jnp.int32)
+        total = n_pairs + n_un_l + n_un_r
+        total_h = int(jax.device_get(total))
+        out_cap = round_up_capacity(max(total_h, 1))
+
+        un_l_idx, _ = compaction_indices(un_l_mask, left.num_rows)
+        un_r_idx, _ = compaction_indices(un_r_mask, right.num_rows)
+
+        @jax.jit
+        def stitch_indices(l_idx, r_idx, un_l_idx, un_r_idx, n_pairs, n_un_l,
+                           n_un_r):
+            i = jnp.arange(out_cap, dtype=jnp.int32)
+            in_pairs = i < n_pairs
+            in_un_l = (i >= n_pairs) & (i < n_pairs + n_un_l)
+            li = jnp.where(in_pairs, l_idx[jnp.clip(i, 0, pair_cap - 1)],
+                           un_l_idx[jnp.clip(i - n_pairs, 0, l_cap - 1)])
+            li = jnp.where(in_un_l | in_pairs, li, 0)
+            l_valid = in_pairs | in_un_l
+            ri = jnp.where(in_pairs, r_idx[jnp.clip(i, 0, pair_cap - 1)],
+                           un_r_idx[jnp.clip(i - n_pairs - n_un_l, 0,
+                                             r_cap - 1)])
+            in_un_r = (i >= n_pairs + n_un_l) & (i < n_pairs + n_un_l + n_un_r)
+            ri = jnp.where(in_pairs | in_un_r, ri, 0)
+            r_valid = in_pairs | in_un_r
+            return li, l_valid, ri, r_valid
+
+        li, l_valid, ri, r_valid = stitch_indices(
+            l_idx, r_idx, un_l_idx, un_r_idx, n_pairs, n_un_l, n_un_r)
+        live = jnp.arange(out_cap, dtype=jnp.int32) < total
+        lcaps = _string_byte_caps(left, li, live & l_valid)
+        rcaps = _string_byte_caps(right, ri, live & r_valid)
+        # NULL-pad: gather with index 0 for padded side, then mask validity.
+        lg = gather_rows(left, jnp.where(l_valid, li, 0), total,
+                         out_capacity=out_cap, out_byte_caps=lcaps or None)
+        rg = gather_rows(right, jnp.where(r_valid, ri, 0), total,
+                         out_capacity=out_cap, out_byte_caps=rcaps or None)
+        lcols = [type(c)(c.dtype, c.data, c.validity & l_valid, c.offsets)
+                 for c in lg.columns]
+        rcols = [type(c)(c.dtype, c.data, c.validity & r_valid, c.offsets)
+                 for c in rg.columns]
+        return ColumnBatch(out_schema, lcols + rcols, total, out_cap)
+
+    raise ValueError(f"unsupported join type: {join_type}")
+
+
+def cross_join(left: ColumnBatch, right: ColumnBatch,
+               out_schema: T.Schema) -> ColumnBatch:
+    """Cartesian product (GpuCartesianProductExec analogue)."""
+    n_l = int(jax.device_get(left.num_rows))
+    n_r = int(jax.device_get(right.num_rows))
+    total = n_l * n_r
+    out_cap = round_up_capacity(max(total, 1))
+    i = jnp.arange(out_cap, dtype=jnp.int32)
+    li = jnp.where(n_r > 0, i // max(n_r, 1), 0).astype(jnp.int32)
+    ri = jnp.where(n_r > 0, i % max(n_r, 1), 0).astype(jnp.int32)
+    total_dev = jnp.asarray(total, jnp.int32)
+    live = i < total_dev
+    lcaps = _string_byte_caps(left, li, live)
+    rcaps = _string_byte_caps(right, ri, live)
+    lg = gather_rows(left, li, total_dev, out_capacity=out_cap,
+                     out_byte_caps=lcaps or None)
+    rg = gather_rows(right, ri, total_dev, out_capacity=out_cap,
+                     out_byte_caps=rcaps or None)
+    return ColumnBatch(out_schema, list(lg.columns) + list(rg.columns),
+                       total_dev, out_cap)

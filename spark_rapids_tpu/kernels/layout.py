@@ -1,0 +1,166 @@
+"""Row-movement kernels: gather, filter compaction, concatenation, head.
+
+Reference analogues: cudf ``Table.filter`` (basicPhysicalOperators.scala:121),
+``Table.concatenate`` (GpuCoalesceBatches.scala), ``contiguousSplit`` /
+gather-based slicing (GpuPartitioning.scala:44-117).
+
+All kernels are pure functions over pytree :class:`ColumnBatch` values and are
+safe to call inside ``jax.jit``.  Output capacities are static arguments.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.batch import ColumnBatch, DeviceColumn
+
+
+def _string_lengths(col: DeviceColumn):
+    return (col.offsets[1:] - col.offsets[:-1]).astype(jnp.int32)
+
+
+def _rows_of_positions(offsets, nbytes: int):
+    pos = jnp.arange(nbytes, dtype=jnp.int32)
+    return jnp.searchsorted(offsets[1:], pos, side="right").astype(jnp.int32)
+
+
+def _gather_string_column(col: DeviceColumn, indices, live, out_cap: int,
+                          out_byte_cap: int) -> DeviceColumn:
+    """Gather whole string rows: new row r = old row indices[r].
+
+    Output bytes are rebuilt with the flat position->row mapping (one
+    searchsorted over the new offsets), so the whole thing is gathers +
+    a cumsum — no per-row loops.
+    """
+    src_lens = _string_lengths(col)
+    new_lens = jnp.where(live, src_lens[indices], 0)
+    new_offsets = jnp.concatenate([
+        jnp.zeros(1, dtype=jnp.int32),
+        jnp.cumsum(new_lens).astype(jnp.int32),
+    ])
+    rows = _rows_of_positions(new_offsets, out_byte_cap)
+    rows_c = jnp.clip(rows, 0, out_cap - 1)
+    pos_in_row = jnp.arange(out_byte_cap, dtype=jnp.int32) - new_offsets[rows_c]
+    src_row = indices[rows_c]
+    src_pos = col.offsets[src_row] + pos_in_row
+    in_range = jnp.arange(out_byte_cap, dtype=jnp.int32) < new_offsets[-1]
+    src_pos = jnp.clip(src_pos, 0, int(col.data.shape[0]) - 1)
+    data = jnp.where(in_range, col.data[src_pos], 0).astype(jnp.uint8)
+    validity = jnp.where(live, col.validity[indices], False)
+    return DeviceColumn(col.dtype, data, validity, new_offsets)
+
+
+def gather_rows(batch: ColumnBatch, indices, num_rows,
+                out_capacity: Optional[int] = None,
+                out_byte_caps: Optional[Sequence[int]] = None) -> ColumnBatch:
+    """New batch whose row r is ``batch`` row ``indices[r]`` for r < num_rows.
+
+    ``indices`` must be int32[out_capacity] (entries past ``num_rows`` are
+    ignored).  ``out_byte_caps`` optionally gives the static byte capacity per
+    string column (defaults to the input column's byte capacity — valid
+    whenever the gather cannot grow total bytes, e.g. permutations/filters).
+    """
+    out_cap = out_capacity if out_capacity is not None else batch.capacity
+    live = jnp.arange(out_cap, dtype=jnp.int32) < num_rows
+    indices = jnp.clip(indices.astype(jnp.int32), 0, batch.capacity - 1)
+    indices = jnp.where(live, indices, 0)
+    cols = []
+    str_i = 0
+    for col in batch.columns:
+        if col.is_string:
+            bcap = (out_byte_caps[str_i] if out_byte_caps is not None
+                    else int(col.data.shape[0]))
+            str_i += 1
+            cols.append(_gather_string_column(col, indices, live, out_cap, bcap))
+        else:
+            data = jnp.where(live, col.data[indices], 0).astype(col.data.dtype)
+            validity = jnp.where(live, col.validity[indices], False)
+            cols.append(DeviceColumn(col.dtype, data, validity, None))
+    return ColumnBatch(batch.schema, cols, jnp.asarray(num_rows, jnp.int32),
+                       out_cap)
+
+
+def compaction_indices(mask, num_rows):
+    """(indices, count): stable order of rows where mask is True and live.
+
+    ``indices`` is int32[cap] — positions of kept rows first (stable),
+    then arbitrary padding.
+    """
+    cap = int(mask.shape[0])
+    live = jnp.arange(cap, dtype=jnp.int32) < num_rows
+    keep = mask & live
+    order = jnp.argsort(jnp.where(keep, 0, 1), stable=True).astype(jnp.int32)
+    return order, jnp.sum(keep).astype(jnp.int32)
+
+
+def compact(batch: ColumnBatch, mask) -> ColumnBatch:
+    """Filter: keep rows where mask (bool[cap]) is True.  Single-phase —
+    output capacity = input capacity (a filter can only shrink)."""
+    indices, count = compaction_indices(mask, batch.num_rows)
+    return gather_rows(batch, indices, count)
+
+
+def take_head(batch: ColumnBatch, limit) -> ColumnBatch:
+    """LocalLimit: clamp the live-row count (no data movement)."""
+    n = jnp.minimum(batch.num_rows, jnp.asarray(limit, jnp.int32))
+    return ColumnBatch(batch.schema, batch.columns, n, batch.capacity)
+
+
+def concat_pair(a: ColumnBatch, b: ColumnBatch, out_capacity: int,
+                out_byte_caps: Optional[Sequence[int]] = None) -> ColumnBatch:
+    """Concatenate two batches (same schema) into one of static capacity.
+
+    Gather-formulated: output row i reads a[i] when i < a.num_rows else
+    b[i - a.num_rows].  ``out_capacity`` must be >= a.capacity + b.capacity
+    is NOT required — only >= total live rows (host guarantees via sizing).
+    """
+    assert a.schema == b.schema, f"{a.schema} != {b.schema}"
+    n_a = a.num_rows
+    total = a.num_rows + b.num_rows
+    live = jnp.arange(out_capacity, dtype=jnp.int32) < total
+    i = jnp.arange(out_capacity, dtype=jnp.int32)
+    from_a = i < n_a
+    ia = jnp.clip(i, 0, a.capacity - 1)
+    ib = jnp.clip(i - n_a, 0, b.capacity - 1)
+    cols = []
+    str_i = 0
+    for f, ca, cb in zip(a.schema.fields, a.columns, b.columns):
+        if f.dtype.is_string:
+            len_a = _string_lengths(ca)
+            len_b = _string_lengths(cb)
+            new_lens = jnp.where(
+                live, jnp.where(from_a, len_a[ia], len_b[ib]), 0)
+            new_offsets = jnp.concatenate([
+                jnp.zeros(1, dtype=jnp.int32),
+                jnp.cumsum(new_lens).astype(jnp.int32),
+            ])
+            bcap_a = int(ca.data.shape[0])
+            bcap_b = int(cb.data.shape[0])
+            bcap = (out_byte_caps[str_i] if out_byte_caps is not None
+                    else bcap_a + bcap_b)
+            str_i += 1
+            rows = _rows_of_positions(new_offsets, bcap)
+            rows_c = jnp.clip(rows, 0, out_capacity - 1)
+            pos_in_row = jnp.arange(bcap, dtype=jnp.int32) - new_offsets[rows_c]
+            row_from_a = from_a[rows_c]
+            src_a = jnp.clip(ca.offsets[ia[rows_c]] + pos_in_row, 0, bcap_a - 1)
+            src_b = jnp.clip(cb.offsets[ib[rows_c]] + pos_in_row, 0, bcap_b - 1)
+            byte = jnp.where(row_from_a, ca.data[src_a], cb.data[src_b])
+            in_range = jnp.arange(bcap, dtype=jnp.int32) < new_offsets[-1]
+            data = jnp.where(in_range, byte, 0).astype(jnp.uint8)
+            validity = jnp.where(
+                live, jnp.where(from_a, ca.validity[ia], cb.validity[ib]),
+                False)
+            cols.append(DeviceColumn(f.dtype, data, validity, new_offsets))
+        else:
+            data = jnp.where(from_a, ca.data[ia], cb.data[ib])
+            data = jnp.where(live, data, 0).astype(ca.data.dtype)
+            validity = jnp.where(
+                live, jnp.where(from_a, ca.validity[ia], cb.validity[ib]),
+                False)
+            cols.append(DeviceColumn(f.dtype, data, validity, None))
+    return ColumnBatch(a.schema, cols, total.astype(jnp.int32), out_capacity)

@@ -1,0 +1,148 @@
+"""Join kernel tests vs a python oracle implementing SQL join semantics."""
+
+import jax
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.batch import HostBatch, device_to_host, host_to_device
+from spark_rapids_tpu.exprs.base import DevVal
+from spark_rapids_tpu.kernels.join import cross_join, hash_join
+
+from conftest import assert_batches_equal
+
+
+def make_batch(pydict):
+    return host_to_device(HostBatch.from_pydict(pydict))
+
+
+def join_oracle(left, right, l_keys, r_keys, how):
+    """Rows as dict-of-lists; returns joined dict-of-lists (unordered)."""
+    lnames = list(left.keys())
+    rnames = list(right.keys())
+    ln = len(left[lnames[0]][1])
+    rn = len(right[rnames[0]][1])
+
+    def key(of, names, i):
+        k = tuple(of[n][1][i] for n in names)
+        return None if any(v is None for v in k) else k
+
+    out = {n: [] for n in lnames + (rnames if how not in
+                                    ("left_semi", "left_anti") else [])}
+    l_matched = [False] * ln
+    r_matched = [False] * rn
+    for i in range(ln):
+        ki = key(left, l_keys, i)
+        for j in range(rn):
+            if ki is not None and ki == key(right, r_keys, j):
+                l_matched[i] = True
+                r_matched[j] = True
+                if how in ("inner", "left", "right", "full"):
+                    for n in lnames:
+                        out[n].append(left[n][1][i])
+                    for n in rnames:
+                        out[n].append(right[n][1][j])
+    if how in ("left", "full"):
+        for i in range(ln):
+            if not l_matched[i]:
+                for n in lnames:
+                    out[n].append(left[n][1][i])
+                for n in rnames:
+                    out[n].append(None)
+    if how in ("right", "full"):
+        for j in range(rn):
+            if not r_matched[j]:
+                for n in lnames:
+                    out[n].append(None)
+                for n in rnames:
+                    out[n].append(right[n][1][j])
+    if how == "left_semi":
+        for i in range(ln):
+            if l_matched[i]:
+                for n in lnames:
+                    out[n].append(left[n][1][i])
+    if how == "left_anti":
+        for i in range(ln):
+            if not l_matched[i]:
+                for n in lnames:
+                    out[n].append(left[n][1][i])
+    return out
+
+
+LEFT = {
+    "k": (T.INT, [1, 2, 2, None, 5, 7]),
+    "ks": (T.STRING, ["a", "b", "b", "c", None, "e"]),
+    "lv": (T.DOUBLE, [0.5, 1.5, 2.5, 3.5, 4.5, None]),
+}
+RIGHT = {
+    "rk": (T.INT, [2, 2, 1, 9, None, 5]),
+    "rks": (T.STRING, ["b", "b", "a", "x", "c", None]),
+    "rv": (T.LONG, [10, 20, 30, 40, None, 60]),
+}
+
+
+def out_schema(how):
+    lf = [(n, LEFT[n][0]) for n in LEFT]
+    rf = [(n, RIGHT[n][0]) for n in RIGHT]
+    if how in ("left_semi", "left_anti"):
+        return T.Schema(lf)
+    return T.Schema(lf + rf)
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full",
+                                 "left_semi", "left_anti"])
+def test_hash_join_two_keys(how):
+    lb = make_batch(LEFT)
+    rb = make_batch(RIGHT)
+    l_keys = [DevVal.from_column(lb.column("k")),
+              DevVal.from_column(lb.column("ks"))]
+    r_keys = [DevVal.from_column(rb.column("rk")),
+              DevVal.from_column(rb.column("rks"))]
+    got_b = hash_join(lb, l_keys, rb, r_keys, how, out_schema(how))
+    got = device_to_host(got_b).to_pydict()
+    exp = join_oracle(LEFT, RIGHT, ["k", "ks"], ["rk", "rks"], how)
+    assert_batches_equal(exp, got, approx=True, ignore_order=True)
+
+
+def test_inner_join_no_matches():
+    lb = make_batch({"k": (T.INT, [1, 2, 3])})
+    rb = make_batch({"rk": (T.INT, [7, 8, 9]), "rv": (T.INT, [1, 2, 3])})
+    got_b = hash_join(
+        lb, [DevVal.from_column(lb.column("k"))],
+        rb, [DevVal.from_column(rb.column("rk"))], "inner",
+        T.Schema([("k", T.INT), ("rk", T.INT), ("rv", T.INT)]))
+    assert int(jax.device_get(got_b.num_rows)) == 0
+
+
+def test_join_duplicate_heavy(rng):
+    n = 300
+    lk = [None if rng.rand() < 0.05 else int(rng.randint(0, 10))
+          for _ in range(n)]
+    rk = [None if rng.rand() < 0.05 else int(rng.randint(0, 10))
+          for _ in range(180)]
+    left = {"k": (T.INT, lk), "lv": (T.INT, list(range(n)))}
+    right = {"rk": (T.INT, rk), "rv": (T.INT, list(range(180)))}
+    lb, rb = make_batch(left), make_batch(right)
+    for how in ("inner", "left", "full"):
+        sch = T.Schema([("k", T.INT), ("lv", T.INT), ("rk", T.INT),
+                        ("rv", T.INT)])
+        got = device_to_host(hash_join(
+            lb, [DevVal.from_column(lb.column("k"))],
+            rb, [DevVal.from_column(rb.column("rk"))], how, sch)).to_pydict()
+        exp = join_oracle(left, right, ["k"], ["rk"], how)
+        assert_batches_equal(exp, got, ignore_order=True)
+
+
+def test_cross_join():
+    left = {"a": (T.INT, [1, 2, 3]), "s": (T.STRING, ["x", "yy", None])}
+    right = {"b": (T.INT, [10, 20])}
+    lb, rb = make_batch(left), make_batch(right)
+    sch = T.Schema([("a", T.INT), ("s", T.STRING), ("b", T.INT)])
+    got = device_to_host(cross_join(lb, rb, sch)).to_pydict()
+    exp = {"a": [], "s": [], "b": []}
+    for i in range(3):
+        for j in range(2):
+            exp["a"].append(left["a"][1][i])
+            exp["s"].append(left["s"][1][i])
+            exp["b"].append(right["b"][1][j])
+    assert_batches_equal(exp, got, ignore_order=True)
