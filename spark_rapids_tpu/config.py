@@ -165,6 +165,18 @@ class RapidsConf:
     def replace_sort_merge_join(self) -> bool:
         return REPLACE_SORT_MERGE_JOIN.get(self)
 
+    @property
+    def explain_enabled(self) -> bool:
+        return str(self.explain).upper() not in ("NONE", "FALSE", "")
+
+    @property
+    def shuffle_partitions(self) -> int:
+        return SHUFFLE_PARTITIONS.get(self)
+
+    @property
+    def coalesce_target_rows(self) -> int:
+        return COALESCE_TARGET_ROWS.get(self)
+
 
 SQL_ENABLED = conf_bool(
     "spark.rapids.sql.enabled", True,
@@ -242,6 +254,9 @@ PARQUET_ENABLED = conf_bool(
 CSV_ENABLED = conf_bool(
     "spark.rapids.sql.format.csv.enabled", True,
     "Enable TPU-accelerated CSV scans.")
+COALESCE_TARGET_ROWS = conf_int(
+    "spark.rapids.sql.coalesce.targetRows", 1 << 20,
+    "Row goal for the batch-coalesce layer (TargetSize analogue).")
 UDF_COMPILER_ENABLED = conf_bool(
     "spark.rapids.sql.udfCompiler.enabled", False,
     "Compile python row UDFs into columnar expressions when possible.")

@@ -1,0 +1,593 @@
+"""Lazy DataFrame frontend over the logical plan.
+
+The reference accelerates Spark's DataFrame/SQL API transparently; this
+engine owns the frontend, exposing a pyspark-flavored API that builds
+:mod:`spark_rapids_tpu.plan.logical` trees.  ``collect()`` runs the
+TpuOverrides planner and executes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exprs.aggregates import (
+    AggregateExpression, AggregateFunction, Average, Count, First, Last, Max,
+    Min, Sum, count_star,
+)
+from spark_rapids_tpu.exprs.base import (
+    Alias, ColumnRef, Expression, Literal, SortOrder, output_name, resolve,
+)
+from spark_rapids_tpu.plan import logical as L
+
+
+class Column:
+    """Expression wrapper with operator sugar (pyspark Column analogue)."""
+
+    def __init__(self, expr: Expression):
+        self.expr = expr
+
+    # comparison / arithmetic build expression trees lazily
+    def _bin(self, other, cls):
+        from spark_rapids_tpu.exprs import arithmetic as A
+        from spark_rapids_tpu.exprs import predicates as P
+        o = _to_expr(other)
+        return Column(cls(self.expr, o))
+
+    def __add__(self, other):
+        from spark_rapids_tpu.exprs.arithmetic import Add
+        return self._bin(other, Add)
+
+    def __radd__(self, other):
+        return Column(_to_expr(other)) + self
+
+    def __sub__(self, other):
+        from spark_rapids_tpu.exprs.arithmetic import Subtract
+        return self._bin(other, Subtract)
+
+    def __rsub__(self, other):
+        return Column(_to_expr(other)) - self
+
+    def __mul__(self, other):
+        from spark_rapids_tpu.exprs.arithmetic import Multiply
+        return self._bin(other, Multiply)
+
+    def __rmul__(self, other):
+        return Column(_to_expr(other)) * self
+
+    def __truediv__(self, other):
+        from spark_rapids_tpu.exprs.arithmetic import Divide
+        return self._bin(other, Divide)
+
+    def __mod__(self, other):
+        from spark_rapids_tpu.exprs.arithmetic import Remainder
+        return self._bin(other, Remainder)
+
+    def __neg__(self):
+        from spark_rapids_tpu.exprs.arithmetic import UnaryMinus
+        return Column(UnaryMinus(self.expr))
+
+    def __eq__(self, other):  # type: ignore[override]
+        from spark_rapids_tpu.exprs.predicates import Equals
+        return self._bin(other, Equals)
+
+    def __ne__(self, other):  # type: ignore[override]
+        from spark_rapids_tpu.exprs.predicates import NotEquals
+        return self._bin(other, NotEquals)
+
+    def __lt__(self, other):
+        from spark_rapids_tpu.exprs.predicates import LessThan
+        return self._bin(other, LessThan)
+
+    def __le__(self, other):
+        from spark_rapids_tpu.exprs.predicates import LessThanOrEqual
+        return self._bin(other, LessThanOrEqual)
+
+    def __gt__(self, other):
+        from spark_rapids_tpu.exprs.predicates import GreaterThan
+        return self._bin(other, GreaterThan)
+
+    def __ge__(self, other):
+        from spark_rapids_tpu.exprs.predicates import GreaterThanOrEqual
+        return self._bin(other, GreaterThanOrEqual)
+
+    def __and__(self, other):
+        from spark_rapids_tpu.exprs.predicates import And
+        return self._bin(other, And)
+
+    def __or__(self, other):
+        from spark_rapids_tpu.exprs.predicates import Or
+        return self._bin(other, Or)
+
+    def __invert__(self):
+        from spark_rapids_tpu.exprs.predicates import Not
+        return Column(Not(self.expr))
+
+    def is_null(self):
+        from spark_rapids_tpu.exprs.nullexprs import IsNull
+        return Column(IsNull(self.expr))
+
+    def is_not_null(self):
+        from spark_rapids_tpu.exprs.nullexprs import IsNotNull
+        return Column(IsNotNull(self.expr))
+
+    def isin(self, *values):
+        from spark_rapids_tpu.exprs.predicates import In
+        vals = values[0] if len(values) == 1 and \
+            isinstance(values[0], (list, tuple, set)) else values
+        return Column(In(self.expr, list(vals)))
+
+    def cast(self, dtype: Union[str, T.DataType]):
+        from spark_rapids_tpu.exprs.cast import Cast
+        dt = T.type_from_name(dtype) if isinstance(dtype, str) else dtype
+        return Column(Cast(self.expr, dt))
+
+    def alias(self, name: str) -> "Column":
+        return Column(Alias(self.expr, name))
+
+    def asc(self, nulls_first: Optional[bool] = None) -> SortOrder:
+        return SortOrder(self.expr, True, nulls_first)
+
+    def desc(self, nulls_first: Optional[bool] = None) -> SortOrder:
+        return SortOrder(self.expr, False, nulls_first)
+
+    def substr(self, start: int, length: int):
+        from spark_rapids_tpu.exprs.strings import Substring
+        return Column(Substring(self.expr, start, length))
+
+    def startswith(self, prefix: str):
+        from spark_rapids_tpu.exprs.strings import StringStartsWith
+        return Column(StringStartsWith(self.expr, Literal(prefix)))
+
+    def endswith(self, suffix: str):
+        from spark_rapids_tpu.exprs.strings import StringEndsWith
+        return Column(StringEndsWith(self.expr, Literal(suffix)))
+
+    def contains(self, needle: str):
+        from spark_rapids_tpu.exprs.strings import StringContains
+        return Column(StringContains(self.expr, Literal(needle)))
+
+    def like(self, pattern: str):
+        from spark_rapids_tpu.exprs.strings import Like
+        return Column(Like(self.expr, pattern))
+
+    def between(self, low, high):
+        return (self >= low) & (self <= high)
+
+    def __repr__(self):
+        return f"Column({self.expr!r})"
+
+    def __hash__(self):
+        return id(self)
+
+
+def _to_expr(v) -> Expression:
+    if isinstance(v, Column):
+        return v.expr
+    if isinstance(v, Expression):
+        return v
+    return Literal(v)
+
+
+def _to_order(v) -> SortOrder:
+    if isinstance(v, SortOrder):
+        return v
+    if isinstance(v, str):
+        return SortOrder(ColumnRef(v), True)
+    if isinstance(v, Column):
+        return SortOrder(v.expr, True)
+    raise TypeError(f"cannot order by {v!r}")
+
+
+class DataFrame:
+    def __init__(self, plan: L.LogicalPlan, session):
+        self.plan = plan
+        self.session = session
+
+    # -- schema -------------------------------------------------------------
+
+    @property
+    def schema(self) -> T.Schema:
+        return self.plan.schema
+
+    @property
+    def columns(self) -> List[str]:
+        return self.plan.schema.names
+
+    def __getitem__(self, name: str) -> Column:
+        f = self.schema.field(name)
+        return Column(ColumnRef(name, f.dtype, f.nullable))
+
+    def col(self, name: str) -> Column:
+        return self[name]
+
+    # -- transformations ----------------------------------------------------
+
+    def _resolve(self, e: Expression) -> Expression:
+        return resolve(e, self.schema)
+
+    def select(self, *cols) -> "DataFrame":
+        exprs, names = [], []
+        for i, c in enumerate(cols):
+            if isinstance(c, str):
+                if c == "*":
+                    for f in self.schema.fields:
+                        exprs.append(ColumnRef(f.name, f.dtype, f.nullable))
+                        names.append(f.name)
+                    continue
+                c = self[c]
+            e = self._resolve(_to_expr(c))
+            exprs.append(e)
+            names.append(output_name(e, i))
+        return DataFrame(L.Project(exprs, names, self.plan), self.session)
+
+    def with_column(self, name: str, col) -> "DataFrame":
+        exprs, names = [], []
+        replaced = False
+        for f in self.schema.fields:
+            if f.name == name:
+                exprs.append(self._resolve(_to_expr(col)))
+                replaced = True
+            else:
+                exprs.append(ColumnRef(f.name, f.dtype, f.nullable))
+            names.append(f.name)
+        if not replaced:
+            exprs.append(self._resolve(_to_expr(col)))
+            names.append(name)
+        return DataFrame(L.Project(exprs, names, self.plan), self.session)
+
+    withColumn = with_column
+
+    def with_column_renamed(self, old: str, new: str) -> "DataFrame":
+        exprs = [ColumnRef(f.name, f.dtype, f.nullable)
+                 for f in self.schema.fields]
+        names = [new if f.name == old else f.name
+                 for f in self.schema.fields]
+        return DataFrame(L.Project(exprs, names, self.plan), self.session)
+
+    def drop(self, *names: str) -> "DataFrame":
+        keep = [f for f in self.schema.fields if f.name not in names]
+        exprs = [ColumnRef(f.name, f.dtype, f.nullable) for f in keep]
+        return DataFrame(L.Project(exprs, [f.name for f in keep], self.plan),
+                         self.session)
+
+    def filter(self, condition) -> "DataFrame":
+        if isinstance(condition, str):
+            from spark_rapids_tpu.sql.parser import parse_expression
+            condition = parse_expression(condition)
+        e = self._resolve(_to_expr(condition))
+        return DataFrame(L.Filter(e, self.plan), self.session)
+
+    where = filter
+
+    def group_by(self, *cols) -> "GroupedData":
+        keys, names = [], []
+        for i, c in enumerate(cols):
+            if isinstance(c, str):
+                c = self[c]
+            e = self._resolve(_to_expr(c))
+            keys.append(e)
+            names.append(output_name(e, i))
+        return GroupedData(self, keys, names)
+
+    groupBy = group_by
+
+    def agg(self, *aggs) -> "DataFrame":
+        return GroupedData(self, [], []).agg(*aggs)
+
+    def join(self, other: "DataFrame", on=None, how: str = "inner"
+             ) -> "DataFrame":
+        how = {"leftouter": "left", "left_outer": "left",
+               "rightouter": "right", "right_outer": "right",
+               "outer": "full", "fullouter": "full", "full_outer": "full",
+               "leftsemi": "left_semi", "semi": "left_semi",
+               "leftanti": "left_anti", "anti": "left_anti"}.get(how, how)
+        lkeys: List[Expression] = []
+        rkeys: List[Expression] = []
+        condition = None
+        if on is None:
+            how = "cross" if how == "inner" else how
+        elif isinstance(on, str):
+            on = [on]
+        if isinstance(on, (list, tuple)):
+            return self._join_using(other, list(on), how)
+        if isinstance(on, Column):
+            # equi-join extraction from a boolean expression
+            lkeys, rkeys, condition = _extract_join_keys(
+                on.expr, self.schema, other.schema)
+        right, mapping = _dedupe_right(
+            self, other, how in ("left_semi", "left_anti"))
+        if mapping:
+            def remap(e: Expression) -> Expression:
+                if isinstance(e, ColumnRef) and e.column in mapping:
+                    return ColumnRef(mapping[e.column], e.dtype, e.nullable)
+                return e
+            rkeys = [k.transform_up(remap) for k in rkeys]
+            if condition is not None:
+                # condition may reference either side; remap only names that
+                # exist solely on the right
+                lnames = set(self.schema.names)
+                def remap_cond(e: Expression) -> Expression:
+                    if isinstance(e, ColumnRef) and e.column in mapping and \
+                            e.column not in lnames:
+                        return ColumnRef(mapping[e.column], e.dtype,
+                                         e.nullable)
+                    return e
+                condition = condition.transform_up(remap_cond)
+        node = L.Join(self.plan, right.plan, lkeys, rkeys, how, condition)
+        return DataFrame(node, self.session)
+
+    def _join_using(self, other: "DataFrame", names: List[str], how: str
+                    ) -> "DataFrame":
+        """USING-join semantics: one output column per key name (left value;
+        right value for right-outer; coalesce for full-outer), then the
+        remaining left columns, then the remaining right columns."""
+        lkeys = [self._resolve(ColumnRef(n)) for n in names]
+        # rename the right key columns so the raw join output has no dups
+        ren = {n: f"__rkey_{i}" for i, n in enumerate(names)}
+        rexprs, rnames = [], []
+        for f in other.schema.fields:
+            rexprs.append(ColumnRef(f.name, f.dtype, f.nullable))
+            rnames.append(ren.get(f.name, f.name))
+        right = DataFrame(L.Project(rexprs, rnames, other.plan),
+                          other.session)
+        right, _mapping = _dedupe_right(
+            self, right, how in ("left_semi", "left_anti"))
+        rkeys = [right._resolve(ColumnRef(ren[n])) for n in names]
+        node = L.Join(self.plan, right.plan, lkeys, rkeys, how, None)
+        joined = DataFrame(node, self.session)
+        if how in ("left_semi", "left_anti"):
+            return joined
+        # final projection: dedupe key columns
+        sch = joined.schema
+        exprs, out_names = [], []
+        for n in names:
+            lref = ColumnRef(n)
+            rref = ColumnRef(ren[n])
+            if how == "right":
+                e = resolve(rref, sch)
+            elif how == "full":
+                from spark_rapids_tpu.exprs.nullexprs import Coalesce
+                e = Coalesce(resolve(lref, sch), resolve(rref, sch))
+            else:
+                e = resolve(lref, sch)
+            exprs.append(e)
+            out_names.append(n)
+        for f in sch.fields:
+            if f.name in names or f.name in ren.values():
+                continue
+            exprs.append(ColumnRef(f.name, f.dtype, f.nullable))
+            out_names.append(f.name)
+        return DataFrame(L.Project(exprs, out_names, joined.plan),
+                         self.session)
+
+    def cross_join(self, other: "DataFrame") -> "DataFrame":
+        node = L.Join(self.plan, other.plan, [], [], "cross", None)
+        return DataFrame(node, self.session)
+
+    crossJoin = cross_join
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(L.Union([self.plan, other.plan]), self.session)
+
+    unionAll = union
+
+    def distinct(self) -> "DataFrame":
+        return DataFrame(L.Distinct(self.plan), self.session)
+
+    def drop_duplicates(self, subset: Optional[List[str]] = None):
+        if subset is None:
+            return self.distinct()
+        keys = [self._resolve(ColumnRef(n)) for n in subset]
+        aggs = [AggregateExpression(First(
+            self._resolve(ColumnRef(f.name))), f.name)
+            for f in self.schema.fields if f.name not in subset]
+        node = L.Aggregate(keys, list(subset), aggs, self.plan)
+        return DataFrame(node, self.session)
+
+    dropDuplicates = drop_duplicates
+
+    def order_by(self, *cols) -> "DataFrame":
+        orders = [self._resolve_order(_to_order(c)) for c in cols]
+        return DataFrame(L.Sort(orders, True, self.plan), self.session)
+
+    orderBy = order_by
+    sort = order_by
+
+    def sort_within_partitions(self, *cols) -> "DataFrame":
+        orders = [self._resolve_order(_to_order(c)) for c in cols]
+        return DataFrame(L.Sort(orders, False, self.plan), self.session)
+
+    def _resolve_order(self, o: SortOrder) -> SortOrder:
+        return SortOrder(self._resolve(o.child), o.ascending, o.nulls_first)
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(L.Limit(n, self.plan), self.session)
+
+    def repartition(self, n: int, *cols) -> "DataFrame":
+        if cols:
+            keys = [self._resolve(_to_expr(self[c] if isinstance(c, str)
+                                           else c)) for c in cols]
+            node = L.Repartition("hash", n, keys, self.plan)
+        else:
+            node = L.Repartition("roundrobin", n, [], self.plan)
+        return DataFrame(node, self.session)
+
+    def coalesce(self, n: int) -> "DataFrame":
+        return DataFrame(L.Repartition("roundrobin", n, [], self.plan),
+                         self.session)
+
+    def sample(self, fraction: float, seed: int = 42) -> "DataFrame":
+        return DataFrame(L.Sample(fraction, seed, self.plan), self.session)
+
+    # -- actions ------------------------------------------------------------
+
+    def collect(self) -> List[tuple]:
+        hb = self.session.execute(self.plan)
+        cols = [c.to_list() for c in hb.columns]
+        return [tuple(c[i] for c in cols) for i in range(hb.num_rows)]
+
+    def to_pydict(self) -> Dict[str, List[Any]]:
+        return self.session.execute(self.plan).to_pydict()
+
+    def to_pandas(self):
+        import pandas as pd
+        return pd.DataFrame(self.to_pydict())
+
+    def count(self) -> int:
+        node = L.Aggregate([], [], [AggregateExpression(count_star(),
+                                                        "count")], self.plan)
+        hb = self.session.execute(node)
+        return int(hb.columns[0].values[0])
+
+    def show(self, n: int = 20):
+        rows = self.limit(n).collect()
+        names = self.columns
+        widths = [max(len(str(x)) for x in [nm] + [r[i] for r in rows])
+                  for i, nm in enumerate(names)]
+        line = "+" + "+".join("-" * (w + 2) for w in widths) + "+"
+        print(line)
+        print("|" + "|".join(f" {nm:<{w}} "
+                             for nm, w in zip(names, widths)) + "|")
+        print(line)
+        for r in rows:
+            print("|" + "|".join(f" {str(x):<{w}} "
+                                 for x, w in zip(r, widths)) + "|")
+        print(line)
+
+    def explain(self) -> str:
+        s = self.session.explain_plan(self.plan)
+        print(s)
+        return s
+
+    def write_parquet(self, path: str, mode: str = "error"):
+        from spark_rapids_tpu.io.writer import write_dataframe
+        write_dataframe(self, "parquet", path, mode)
+
+    def write_csv(self, path: str, mode: str = "error"):
+        from spark_rapids_tpu.io.writer import write_dataframe
+        write_dataframe(self, "csv", path, mode)
+
+    def write_orc(self, path: str, mode: str = "error"):
+        from spark_rapids_tpu.io.writer import write_dataframe
+        write_dataframe(self, "orc", path, mode)
+
+
+def _dedupe_right(left: "DataFrame", right: "DataFrame", is_semi: bool):
+    """Rename right-side columns that collide with left-side names
+    (suffix ``_r``) so the joined schema is unambiguous.  Semi/anti joins
+    output only the left side, so no rename is needed.
+
+    Returns (right_df, {old_name: new_name})."""
+    if is_semi:
+        return right, {}
+    lnames = set(left.schema.names)
+    if not (lnames & set(right.schema.names)):
+        return right, {}
+    exprs, names, mapping = [], [], {}
+    for f in right.schema.fields:
+        exprs.append(ColumnRef(f.name, f.dtype, f.nullable))
+        nm = f.name
+        while nm in lnames:
+            nm = nm + "_r"
+        if nm != f.name:
+            mapping[f.name] = nm
+        names.append(nm)
+    return DataFrame(L.Project(exprs, names, right.plan),
+                     right.session), mapping
+
+
+def _extract_join_keys(expr: Expression, lschema: T.Schema,
+                       rschema: T.Schema):
+    """Split a join condition into equi-key pairs + residual condition."""
+    from spark_rapids_tpu.exprs.predicates import And, Equals as EqualTo
+    lkeys, rkeys, residual = [], [], []
+
+    def visit(e: Expression):
+        if isinstance(e, And):
+            visit(e.children[0])
+            visit(e.children[1])
+            return
+        if isinstance(e, EqualTo):
+            a, b = e.children
+            if isinstance(a, ColumnRef) and isinstance(b, ColumnRef):
+                if a.column in lschema and b.column in rschema:
+                    lkeys.append(resolve(a, lschema))
+                    rkeys.append(resolve(b, rschema))
+                    return
+                if b.column in lschema and a.column in rschema:
+                    lkeys.append(resolve(b, lschema))
+                    rkeys.append(resolve(a, rschema))
+                    return
+        residual.append(e)
+
+    visit(expr)
+    cond = None
+    if residual:
+        from spark_rapids_tpu.exprs.predicates import And as AndE
+        cond = residual[0]
+        for r in residual[1:]:
+            cond = AndE(cond, r)
+    return lkeys, rkeys, cond
+
+
+class GroupedData:
+    def __init__(self, df: DataFrame, keys: List[Expression],
+                 names: List[str]):
+        self.df = df
+        self.keys = keys
+        self.names = names
+
+    def agg(self, *aggs) -> DataFrame:
+        out: List[AggregateExpression] = []
+        for i, a in enumerate(aggs):
+            if isinstance(a, AggregateExpression):
+                out.append(a)
+            elif isinstance(a, Column):
+                e = a.expr
+                name = None
+                if isinstance(e, Alias):
+                    name = e.alias_name
+                    e = e.children[0]
+                if not isinstance(e, AggregateFunction):
+                    raise TypeError(f"not an aggregate: {a!r}")
+                e = _resolve_agg(e, self.df.schema)
+                out.append(AggregateExpression(
+                    e, name or f"{e.name.lower()}_{i}"))
+            else:
+                raise TypeError(f"not an aggregate: {a!r}")
+        node = L.Aggregate(self.keys, self.names, out, self.df.plan)
+        return DataFrame(node, self.df.session)
+
+    def count(self) -> DataFrame:
+        return self.agg(Column(Alias(count_star(), "count")))
+
+    def _simple(self, cls, cols) -> DataFrame:
+        targets = cols or [f.name for f in self.df.schema.fields
+                           if f.dtype.is_numeric]
+        aggs = [Column(Alias(cls(self.df._resolve(ColumnRef(c))),
+                             f"{cls.__name__.lower()}({c})"))
+                for c in targets]
+        return self.agg(*aggs)
+
+    def sum(self, *cols):
+        return self._simple(Sum, cols)
+
+    def avg(self, *cols):
+        return self._simple(Average, cols)
+
+    mean = avg
+
+    def min(self, *cols):
+        return self._simple(Min, cols)
+
+    def max(self, *cols):
+        return self._simple(Max, cols)
+
+
+def _resolve_agg(fn: AggregateFunction, schema: T.Schema
+                 ) -> AggregateFunction:
+    child = resolve(fn.fn_child if hasattr(fn, "fn_child") else fn.child,
+                    schema)
+    new = fn.with_children([child])
+    return new

@@ -1,0 +1,8 @@
+"""File-format IO: host-side decode (pyarrow) staged into HBM, and columnar
+writers (reference: GpuParquetScan.scala, GpuOrcScan.scala,
+GpuBatchScanExec.scala CSV, writers — SURVEY.md section 2.6).
+
+TPU adaptation (SURVEY.md section 2.9): a TPU cannot decode parquet on
+device the way cudf does on GPU, so decode runs on host threads
+(multi-threaded read-ahead, the MultiFileParquetPartitionReader analogue)
+and dense columns are staged asynchronously into device memory."""

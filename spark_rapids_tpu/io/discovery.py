@@ -1,0 +1,65 @@
+"""Path expansion + schema inference for file sources."""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Any, Dict, List
+
+import pyarrow as pa
+
+from spark_rapids_tpu import types as T
+
+_EXTS = {"parquet": (".parquet", ".parq"), "csv": (".csv",),
+         "orc": (".orc",)}
+
+
+def expand_paths(paths: List[str], fmt: str) -> List[str]:
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, names in os.walk(p):
+                for n in sorted(names):
+                    if n.startswith(("_", ".")):
+                        continue
+                    if n.endswith(_EXTS.get(fmt, ())):
+                        files.append(os.path.join(root, n))
+        elif any(ch in p for ch in "*?["):
+            files.extend(sorted(glob.glob(p)))
+        else:
+            files.append(p)
+    if not files:
+        raise FileNotFoundError(f"no {fmt} files found in {paths}")
+    return files
+
+
+def infer_schema(fmt: str, files: List[str],
+                 options: Dict[str, Any]) -> T.Schema:
+    from spark_rapids_tpu.io.arrow_convert import schema_from_arrow
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+        return schema_from_arrow(pq.read_schema(files[0]))
+    if fmt == "orc":
+        import pyarrow.orc as orc
+        return schema_from_arrow(orc.ORCFile(files[0]).schema)
+    if fmt == "csv":
+        import pyarrow.csv as pacsv
+        read_opts, parse_opts, conv_opts = csv_options(options)
+        tb = pacsv.read_csv(files[0], read_options=read_opts,
+                            parse_options=parse_opts,
+                            convert_options=conv_opts)
+        return schema_from_arrow(tb.schema)
+    raise ValueError(f"unknown format {fmt}")
+
+
+def csv_options(options: Dict[str, Any]):
+    import pyarrow.csv as pacsv
+    header = str(options.get("header", "true")).lower() == "true"
+    sep = options.get("sep", options.get("delimiter", ","))
+    read_opts = pacsv.ReadOptions(
+        autogenerate_column_names=not header)
+    parse_opts = pacsv.ParseOptions(delimiter=sep)
+    conv_opts = pacsv.ConvertOptions(
+        null_values=[options.get("nullValue", "")],
+        strings_can_be_null=True)
+    return read_opts, parse_opts, conv_opts

@@ -1,0 +1,109 @@
+"""File-scan exec: multi-threaded host decode of parquet/orc/csv into
+HostBatches (GpuParquetScan.scala:68 structure: host-side footer/filter work,
+then decode; here decode itself is host-side by design — SURVEY.md 2.9 row 2 —
+with a read-ahead thread pool mirroring MultiFileParquetPartitionReader,
+GpuParquetScan.scala:647-700)."""
+
+from __future__ import annotations
+
+import concurrent.futures
+import queue
+import threading
+from typing import Any, Dict, Iterator, List, Optional
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.batch import HostBatch
+from spark_rapids_tpu.config import (
+    MULTITHREADED_READ_THREADS, RapidsConf,
+)
+from spark_rapids_tpu.io.arrow_convert import arrow_to_host_batch
+from spark_rapids_tpu.io.discovery import csv_options
+from spark_rapids_tpu.plan.physical import CpuExec, ExecContext
+
+
+def _read_parquet_file(path: str, columns: List[str], batch_rows: int,
+                       filters=None) -> List[HostBatch]:
+    import pyarrow.parquet as pq
+    out = []
+    f = pq.ParquetFile(path)
+    for rb in f.iter_batches(batch_size=batch_rows,
+                             columns=columns or None):
+        out.append(arrow_to_host_batch(rb))
+    return out
+
+
+def _read_orc_file(path: str, columns: List[str], batch_rows: int
+                   ) -> List[HostBatch]:
+    import pyarrow.orc as orc
+    f = orc.ORCFile(path)
+    tb = f.read(columns=columns or None)
+    hb = arrow_to_host_batch(tb)
+    return [hb.slice(i, min(batch_rows, hb.num_rows - i))
+            for i in range(0, max(hb.num_rows, 1), batch_rows)] \
+        if hb.num_rows else []
+
+
+def _read_csv_file(path: str, columns: List[str], batch_rows: int,
+                   options: Dict[str, Any]) -> List[HostBatch]:
+    import pyarrow.csv as pacsv
+    read_opts, parse_opts, conv_opts = csv_options(options)
+    if columns:
+        conv_opts.include_columns = columns
+    tb = pacsv.read_csv(path, read_options=read_opts,
+                        parse_options=parse_opts, convert_options=conv_opts)
+    hb = arrow_to_host_batch(tb)
+    return [hb.slice(i, min(batch_rows, hb.num_rows - i))
+            for i in range(0, max(hb.num_rows, 1), batch_rows)] \
+        if hb.num_rows else []
+
+
+class CpuFileScanExec(CpuExec):
+    """Reads files with a shared thread pool, one partition per file group.
+
+    Partitioning: files are assigned round-robin to
+    ``spark.sql.shuffle.partitions`` partitions (or fewer when there are
+    fewer files)."""
+
+    def __init__(self, node, conf: RapidsConf):
+        super().__init__([], node.schema)
+        self.node = node
+        self.conf = conf
+        self.fmt = node.fmt
+        self.paths = node.paths
+        self.options = node.options
+        self._nthreads = MULTITHREADED_READ_THREADS.get(conf)
+
+    def describe(self):
+        return f"CpuFileScan({self.fmt}, {len(self.paths)} files)"
+
+    def num_partitions(self, ctx):
+        return max(1, min(len(self.paths), self.conf.shuffle_partitions))
+
+    def _read_file(self, path: str) -> List[HostBatch]:
+        batch_rows = self.conf.max_readers_batch_size_rows
+        columns = self.output_schema.names
+        if self.fmt == "parquet":
+            return _read_parquet_file(path, columns, batch_rows)
+        if self.fmt == "orc":
+            return _read_orc_file(path, columns, batch_rows)
+        if self.fmt == "csv":
+            return _read_csv_file(path, columns, batch_rows, self.options)
+        raise ValueError(self.fmt)
+
+    def partitions(self, ctx: ExecContext):
+        n = self.num_partitions(ctx)
+        groups: List[List[str]] = [[] for _ in range(n)]
+        for i, p in enumerate(self.paths):
+            groups[i % n].append(p)
+        pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=self._nthreads)
+
+        def gen(files: List[str]):
+            # read-ahead: submit all files in this partition to the pool
+            futures = [pool.submit(self._read_file, f) for f in files]
+            for fu in futures:
+                for hb in fu.result():
+                    if hb.num_rows:
+                        yield hb
+
+        return [gen(g) for g in groups]

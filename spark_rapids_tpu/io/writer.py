@@ -1,0 +1,83 @@
+"""Columnar writers (GpuParquetFileFormat / GpuOrcFileFormat /
+ColumnarOutputWriter analogues, SURVEY.md section 2.6): one output file per
+partition, written host-side from staged batches via Arrow."""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import List
+
+from spark_rapids_tpu.batch import HostBatch
+from spark_rapids_tpu.io.arrow_convert import host_batch_to_arrow
+
+
+def _prepare_dir(path: str, mode: str):
+    if os.path.exists(path):
+        if mode == "overwrite":
+            shutil.rmtree(path)
+        elif mode in ("error", "errorifexists"):
+            raise FileExistsError(path)
+        elif mode == "ignore":
+            return False
+    os.makedirs(path, exist_ok=True)
+    return True
+
+
+def write_dataframe(df, fmt: str, path: str, mode: str = "error"):
+    """Execute the plan and write one file per partition."""
+    from spark_rapids_tpu.plan.overrides import TpuOverrides
+    from spark_rapids_tpu.plan.physical import (
+        DeviceToHostExec, ExecContext,
+    )
+    if not _prepare_dir(path, mode):
+        return
+    session = df.session
+    overrides = TpuOverrides(session.conf)
+    phys = overrides.apply(df.plan)
+    if phys.is_tpu:
+        phys = DeviceToHostExec(phys)
+    ctx = ExecContext(
+        session.conf,
+        semaphore=session.runtime.semaphore if session.runtime else None,
+        device=session.runtime.device if session.runtime else None)
+    wrote = 0
+    for pi, part in enumerate(phys.partitions(ctx)):
+        batches: List[HostBatch] = [hb for hb in part if hb.num_rows]
+        if not batches:
+            continue
+        hb = HostBatch.concat(batches)
+        table = host_batch_to_arrow(hb)
+        fname = os.path.join(path, f"part-{pi:05d}.{_ext(fmt)}")
+        if fmt == "parquet":
+            import pyarrow.parquet as pq
+            pq.write_table(table, fname)
+        elif fmt == "orc":
+            import pyarrow.orc as paorc
+            paorc.write_table(table, fname)
+        elif fmt == "csv":
+            import pyarrow.csv as pacsv
+            pacsv.write_csv(table, fname)
+        else:
+            raise ValueError(fmt)
+        wrote += 1
+    if wrote == 0:
+        # still write an empty marker file with the schema for parquet
+        if fmt == "parquet":
+            import pyarrow.parquet as pq
+            empty = host_batch_to_arrow(HostBatch(df.plan.schema, [
+                _empty_col(f) for f in df.plan.schema.fields]))
+            pq.write_table(empty,
+                           os.path.join(path, f"part-00000.parquet"))
+    open(os.path.join(path, "_SUCCESS"), "w").close()
+
+
+def _empty_col(f):
+    import numpy as np
+    from spark_rapids_tpu.batch import HostColumn
+    vals = np.zeros(0, dtype=object if f.dtype.is_string else f.dtype.np_dtype)
+    return HostColumn(f.dtype, vals, np.zeros(0, dtype=np.bool_))
+
+
+def _ext(fmt: str) -> str:
+    return {"parquet": "parquet", "orc": "orc", "csv": "csv"}[fmt]

@@ -1,0 +1,3 @@
+"""Tiered memory management: device -> host -> disk spill
+(reference: RapidsBufferCatalog + RapidsBufferStore tiers, SURVEY.md
+section 2.4)."""

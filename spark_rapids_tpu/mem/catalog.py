@@ -1,0 +1,221 @@
+"""Spillable-buffer catalog with device -> host -> disk tiers.
+
+Reference analogues: RapidsBufferCatalog.scala:36 (id->buffer registry wiring
+the spill chain), RapidsBufferStore.scala:39 (priority-ordered spillable
+tracking + synchronousSpill), RapidsDeviceMemoryStore / RapidsHostMemoryStore
+/ RapidsDiskStore, SpillableColumnarBatch.scala:27 (operator-facing handle),
+SpillPriorities.scala.
+
+TPU adaptation: XLA owns HBM allocation and exposes no alloc-failure callback
+(the RMM event-handler hook, DeviceMemoryEventHandler.scala:35).  Instead the
+catalog enforces a *budget*: every operator that holds batches across
+pipeline breaks registers them as SpillableBatch handles; when registered
+device bytes exceed the budget the catalog synchronously spills
+lowest-priority handles to host numpy, and past the host-store bound to disk
+(.npz files) — same three tiers, push model instead of callback model.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.batch import (
+    ColumnBatch, HostBatch, device_to_host, host_to_device,
+)
+from spark_rapids_tpu.config import RapidsConf, conf_bytes
+
+DEVICE_SPILL_BUDGET = conf_bytes(
+    "spark.rapids.memory.tpu.spillBudgetBytes", 8 << 30,
+    "Device bytes the catalog lets spillable batches occupy before "
+    "synchronously spilling lowest-priority ones to host.")
+
+# Spill priority bands (SpillPriorities.scala:17-61).
+PRIORITY_INPUT = 0
+PRIORITY_SHUFFLE_OUTPUT = -1000
+PRIORITY_ON_DECK = 1000
+
+
+def device_batch_bytes(batch: ColumnBatch) -> int:
+    total = 0
+    for c in batch.columns:
+        total += int(np.dtype(c.data.dtype).itemsize) * int(c.data.size)
+        total += int(c.validity.size)
+        if c.offsets is not None:
+            total += 4 * int(c.offsets.size)
+    return total
+
+
+class SpillableBatch:
+    """Operator-facing handle for a batch that may move between tiers."""
+
+    TIER_DEVICE, TIER_HOST, TIER_DISK = 0, 1, 2
+
+    def __init__(self, catalog: "BufferCatalog", batch_id: int,
+                 device_batch: ColumnBatch, priority: int):
+        self._catalog = catalog
+        self.batch_id = batch_id
+        self.priority = priority
+        self.tier = self.TIER_DEVICE
+        self._device: Optional[ColumnBatch] = device_batch
+        self._host: Optional[HostBatch] = None
+        self._disk_path: Optional[str] = None
+        self._schema = device_batch.schema
+        self._capacity = device_batch.capacity
+        self.device_bytes = device_batch_bytes(device_batch)
+        self.closed = False
+
+    # -- tier moves (catalog-internal) --------------------------------------
+
+    def _spill_to_host(self):
+        assert self.tier == self.TIER_DEVICE
+        self._host = device_to_host(self._device)
+        self._device = None
+        self.tier = self.TIER_HOST
+
+    def _spill_to_disk(self, directory: str):
+        assert self.tier == self.TIER_HOST
+        path = os.path.join(directory, f"spill-{self.batch_id}.npz")
+        arrays = {}
+        for i, c in enumerate(self._host.columns):
+            if c.dtype.is_string:
+                arrays[f"v{i}"] = np.array(
+                    ["" if x is None else str(x) for x in c.to_list()],
+                    dtype=object)
+            else:
+                arrays[f"v{i}"] = c.values
+            arrays[f"m{i}"] = c.validity
+        np.savez(path, **arrays)
+        self._disk_path = path
+        self._host = None
+        self.tier = self.TIER_DISK
+
+    def _read_disk(self) -> HostBatch:
+        from spark_rapids_tpu.batch import HostColumn
+        data = np.load(self._disk_path, allow_pickle=True)
+        cols = []
+        for i, f in enumerate(self._schema.fields):
+            cols.append(HostColumn(f.dtype, data[f"v{i}"], data[f"m{i}"]))
+        return HostBatch(self._schema, cols)
+
+    def host_bytes(self) -> int:
+        if self._host is None:
+            return 0
+        total = 0
+        for c in self._host.columns:
+            if c.dtype.is_string:
+                total += sum(len(str(x)) for x in c.values) + len(c.values)
+            else:
+                total += c.values.nbytes
+            total += c.validity.nbytes
+        return total
+
+    # -- public -------------------------------------------------------------
+
+    def get(self) -> ColumnBatch:
+        """Materialize on device (unspilling if needed)."""
+        assert not self.closed
+        if self.tier == self.TIER_DEVICE:
+            return self._device
+        if self.tier == self.TIER_DISK:
+            self._host = self._read_disk()
+            self._disk_path = None
+            self.tier = self.TIER_HOST
+        if self.tier == self.TIER_HOST:
+            self._catalog.reserve(self.device_bytes, exclude=self.batch_id)
+            self._device = host_to_device(self._host, capacity=self._capacity)
+            self._host = None
+            self.tier = self.TIER_DEVICE
+        return self._device
+
+    def close(self):
+        if self.closed:
+            return
+        self.closed = True
+        if self._disk_path and os.path.exists(self._disk_path):
+            os.unlink(self._disk_path)
+        self._device = None
+        self._host = None
+        self._catalog._unregister(self)
+
+
+class BufferCatalog:
+    """Process-wide registry of spillable batches with a device budget."""
+
+    def __init__(self, conf: RapidsConf):
+        self.conf = conf
+        self.device_budget = DEVICE_SPILL_BUDGET.get(conf)
+        self.host_budget = conf.host_spill_storage_size
+        self._handles: Dict[int, SpillableBatch] = {}
+        self._next_id = 0
+        self._lock = threading.RLock()
+        self._spill_dir: Optional[str] = None
+        self.metrics = {"spilled_to_host": 0, "spilled_to_disk": 0,
+                        "unspilled": 0}
+
+    def _dir(self) -> str:
+        if self._spill_dir is None:
+            self._spill_dir = tempfile.mkdtemp(prefix="rapids_tpu_spill_")
+        return self._spill_dir
+
+    def register(self, batch: ColumnBatch,
+                 priority: int = PRIORITY_INPUT) -> SpillableBatch:
+        with self._lock:
+            h = SpillableBatch(self, self._next_id, batch, priority)
+            self._next_id += 1
+            self._handles[h.batch_id] = h
+            self.reserve(0, exclude=h.batch_id)
+            return h
+
+    def _unregister(self, h: SpillableBatch):
+        with self._lock:
+            self._handles.pop(h.batch_id, None)
+
+    def device_bytes_in_use(self) -> int:
+        with self._lock:
+            return sum(h.device_bytes for h in self._handles.values()
+                       if h.tier == SpillableBatch.TIER_DEVICE)
+
+    def host_bytes_in_use(self) -> int:
+        with self._lock:
+            return sum(h.host_bytes() for h in self._handles.values()
+                       if h.tier == SpillableBatch.TIER_HOST)
+
+    def reserve(self, incoming_bytes: int, exclude: int = -1):
+        """Synchronously spill until (in_use + incoming) fits the budget
+        (the synchronousSpill loop, RapidsBufferStore.scala:144)."""
+        with self._lock:
+            while self.device_bytes_in_use() + incoming_bytes > \
+                    self.device_budget:
+                victim = self._pick_victim(
+                    SpillableBatch.TIER_DEVICE, exclude)
+                if victim is None:
+                    break
+                victim._spill_to_host()
+                self.metrics["spilled_to_host"] += 1
+                self._enforce_host_budget()
+
+    def _enforce_host_budget(self):
+        while self.host_bytes_in_use() > self.host_budget:
+            victim = self._pick_victim(SpillableBatch.TIER_HOST, -1)
+            if victim is None:
+                break
+            victim._spill_to_disk(self._dir())
+            self.metrics["spilled_to_disk"] += 1
+
+    def _pick_victim(self, tier: int, exclude: int
+                     ) -> Optional[SpillableBatch]:
+        best = None
+        for h in self._handles.values():
+            if h.tier != tier or h.batch_id == exclude or h.closed:
+                continue
+            if best is None or h.priority < best.priority or \
+                    (h.priority == best.priority and
+                     h.batch_id < best.batch_id):
+                best = h
+        return best

@@ -1,0 +1,3 @@
+"""Physical operator implementations: TPU execs (device kernels) and their
+CPU fallback twins (numpy/python), mirroring the reference's GpuExec library
+(SURVEY.md section 2.5) plus per-operator CPU fallback."""

@@ -1,0 +1,447 @@
+"""CPU (host, numpy/python) physical operators — the per-operator fallback
+path.  In the reference, unsupported operators simply stay as Spark CPU execs
+(RapidsMeta.scala willNotWorkOnGpu); here the engine owns both sides, so every
+operator has an explicit host implementation with Spark CPU semantics.  These
+double as the correctness oracle for the TPU execs.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.batch import HostBatch, HostColumn
+from spark_rapids_tpu.exprs.aggregates import AggregateExpression
+from spark_rapids_tpu.exprs.base import (
+    CpuEvalCtx, Expression, SortOrder, output_name,
+)
+from spark_rapids_tpu.plan.physical import CpuExec, ExecContext, PhysicalOp
+
+
+def _rows(batch: HostBatch) -> List[tuple]:
+    cols = [c.to_list() for c in batch.columns]
+    return list(zip(*cols)) if cols else [() for _ in range(batch.num_rows)]
+
+
+def _from_rows(schema: T.Schema, rows: List[tuple]) -> HostBatch:
+    cols = []
+    for i, f in enumerate(schema.fields):
+        items = [r[i] for r in rows]
+        cols.append(HostColumn.from_list(f.dtype, items))
+    return HostBatch(schema, cols)
+
+
+def sort_key_fn(orders: List[SortOrder], key_ordinals: List[int]
+                ) -> Callable[[tuple], tuple]:
+    """Spark-semantics sort key for python rows (NaN greatest, nulls per
+    nulls_first, descending via wrapper)."""
+
+    class _Desc:
+        __slots__ = ("v",)
+
+        def __init__(self, v):
+            self.v = v
+
+        def __lt__(self, o):
+            return o.v < self.v
+
+        def __eq__(self, o):
+            return o.v == self.v
+
+    def enc(v, o: SortOrder):
+        if v is None:
+            return (0 if o.nulls_first else 1, 0)
+        if isinstance(v, float) and math.isnan(v):
+            core = (1, 0.0)
+        elif isinstance(v, bool):
+            core = (0, int(v))
+        elif isinstance(v, str):
+            core = (0, v.encode("utf-8"))
+        else:
+            core = (0, v)
+        rank = 1 if o.nulls_first else 0
+        return (rank, core if o.ascending else _Desc(core))
+
+    def key(row):
+        return tuple(enc(row[i], o) for i, o in zip(key_ordinals, orders))
+
+    return key
+
+
+class CpuInMemoryScanExec(CpuExec):
+    def __init__(self, batches: List[HostBatch], schema: T.Schema,
+                 num_partitions: int):
+        super().__init__([], schema)
+        self.batches = batches
+        self._n = max(1, num_partitions)
+
+    def num_partitions(self, ctx):
+        return self._n
+
+    def partitions(self, ctx):
+        parts: List[List[HostBatch]] = [[] for _ in range(self._n)]
+        for i, b in enumerate(self.batches):
+            parts[i % self._n].append(b)
+        return [iter(p) for p in parts]
+
+
+class CpuRangeExec(CpuExec):
+    def __init__(self, start, end, step, num_partitions, schema):
+        super().__init__([], schema)
+        self.start, self.end, self.step = start, end, step
+        self._n = max(1, num_partitions)
+
+    def num_partitions(self, ctx):
+        return self._n
+
+    def partitions(self, ctx):
+        total = max(0, -(-(self.end - self.start) // self.step))
+        per = -(-total // self._n)
+
+        def gen(p):
+            lo = self.start + p * per * self.step
+            hi = min(self.start + (p + 1) * per * self.step, self.end) \
+                if self.step > 0 else max(
+                    self.start + (p + 1) * per * self.step, self.end)
+            vals = np.arange(lo, hi, self.step, dtype=np.int64)
+            if len(vals):
+                yield HostBatch(self.output_schema, [
+                    HostColumn(T.LONG, vals, np.ones(len(vals), np.bool_))
+                ])
+
+        return [gen(p) for p in range(self._n)]
+
+
+class CpuProjectExec(CpuExec):
+    def __init__(self, exprs: List[Expression], child: PhysicalOp,
+                 schema: T.Schema):
+        super().__init__([child], schema)
+        self.exprs = exprs
+
+    def describe(self):
+        return f"CpuProject({', '.join(f.name for f in self.output_schema)})"
+
+    def partitions(self, ctx):
+        def gen(part):
+            for hb in part:
+                cctx = CpuEvalCtx(hb)
+                cols = [e.cpu_eval(cctx).to_column() for e in self.exprs]
+                yield HostBatch(self.output_schema, cols)
+
+        return [gen(p) for p in self.children[0].partitions(ctx)]
+
+
+class CpuFilterExec(CpuExec):
+    def __init__(self, condition: Expression, child: PhysicalOp):
+        super().__init__([child], child.output_schema)
+        self.condition = condition
+
+    def describe(self):
+        return f"CpuFilter({self.condition!r})"
+
+    def partitions(self, ctx):
+        def gen(part):
+            for hb in part:
+                cctx = CpuEvalCtx(hb)
+                v = self.condition.cpu_eval(cctx)
+                keep = v.validity & v.values.astype(bool)
+                cols = [HostColumn(c.dtype, c.values[keep], c.validity[keep])
+                        for c in hb.columns]
+                out = HostBatch(hb.schema, cols)
+                if out.num_rows:
+                    yield out
+
+        return [gen(p) for p in self.children[0].partitions(ctx)]
+
+
+class CpuUnionExec(CpuExec):
+    def __init__(self, children: List[PhysicalOp], schema: T.Schema):
+        super().__init__(children, schema)
+
+    def num_partitions(self, ctx):
+        return sum(c.num_partitions(ctx) for c in self.children)
+
+    def partitions(self, ctx):
+        out = []
+        for c in self.children:
+            for p in c.partitions(ctx):
+                out.append(self._rename(p))
+        return out
+
+    def _rename(self, part):
+        for hb in part:
+            yield HostBatch(self.output_schema, hb.columns)
+
+
+class CpuLocalLimitExec(CpuExec):
+    def __init__(self, n: int, child: PhysicalOp):
+        super().__init__([child], child.output_schema)
+        self.n = n
+
+    def partitions(self, ctx):
+        def gen(part):
+            left = self.n
+            for hb in part:
+                if left <= 0:
+                    break
+                if hb.num_rows > left:
+                    hb = hb.slice(0, left)
+                left -= hb.num_rows
+                yield hb
+
+        return [gen(p) for p in self.children[0].partitions(ctx)]
+
+
+class CpuSortExec(CpuExec):
+    def __init__(self, orders: List[SortOrder], key_ordinals: List[int],
+                 child: PhysicalOp):
+        super().__init__([child], child.output_schema)
+        self.orders = orders
+        self.key_ordinals = key_ordinals
+
+    def describe(self):
+        return f"CpuSort({len(self.orders)} keys)"
+
+    def partitions(self, ctx):
+        key = sort_key_fn(self.orders, self.key_ordinals)
+
+        def gen(part):
+            rows = []
+            for hb in part:
+                rows.extend(_rows(hb))
+            rows.sort(key=key)
+            if rows:
+                yield _from_rows(self.output_schema, rows)
+
+        return [gen(p) for p in self.children[0].partitions(ctx)]
+
+
+class CpuAggregateExec(CpuExec):
+    """Whole-aggregation on host: dict-of-key-tuples grouping.
+
+    Used when the agg falls back; partial/final split is unnecessary on host
+    because this exec runs *after* an exchange has co-located each key's rows
+    (or on a single partition for reductions)."""
+
+    def __init__(self, key_exprs: List[Expression],
+                 key_ordinals_in_child: List[Expression],
+                 aggs: List[AggregateExpression], child: PhysicalOp,
+                 schema: T.Schema):
+        super().__init__([child], schema)
+        self.key_exprs = key_exprs
+        self.aggs = aggs
+
+    def describe(self):
+        return f"CpuAggregate(keys={len(self.key_exprs)})"
+
+    def partitions(self, ctx):
+        def gen(part):
+            groups: Dict[tuple, List[List]] = {}
+            key_order: List[tuple] = []
+            n_aggs = len(self.aggs)
+            for hb in part:
+                cctx = CpuEvalCtx(hb)
+                key_cols = [e.cpu_eval(cctx).to_column().to_list()
+                            for e in self.key_exprs]
+                in_cols = []
+                for a in self.aggs:
+                    v = a.fn.child.cpu_eval(cctx)
+                    in_cols.append((v.values, v.validity))
+                for r in range(hb.num_rows):
+                    k = tuple(col[r] for col in key_cols)
+                    if k not in groups:
+                        groups[k] = [[] for _ in range(n_aggs)]
+                        key_order.append(k)
+                    g = groups[k]
+                    for i in range(n_aggs):
+                        vals, valid = in_cols[i]
+                        g[i].append((vals[r], bool(valid[r])))
+            if not key_order and not self.key_exprs:
+                key_order = [()]
+                groups[()] = [[] for _ in range(n_aggs)]
+            if not key_order:
+                return
+            rows = []
+            for k in key_order:
+                out_row = list(k)
+                for i, a in enumerate(self.aggs):
+                    pairs = groups[k][i]
+                    if pairs:
+                        vals = np.array([p[0] for p in pairs])
+                        valid = np.array([p[1] for p in pairs], dtype=bool)
+                    else:
+                        vals = np.zeros(0)
+                        valid = np.zeros(0, dtype=bool)
+                    out_row.append(a.fn.cpu_reduce(vals, valid))
+                rows.append(tuple(out_row))
+            yield _from_rows(self.output_schema, rows)
+
+        return [gen(p) for p in self.children[0].partitions(ctx)]
+
+
+class CpuHashJoinExec(CpuExec):
+    def __init__(self, left: PhysicalOp, right: PhysicalOp,
+                 left_keys: List[Expression], right_keys: List[Expression],
+                 how: str, condition: Optional[Expression],
+                 schema: T.Schema):
+        super().__init__([left, right], schema)
+        self.left_keys = left_keys
+        self.right_keys = right_keys
+        self.how = how
+        self.condition = condition
+
+    def describe(self):
+        return f"CpuHashJoin({self.how})"
+
+    def num_partitions(self, ctx):
+        return self.children[0].num_partitions(ctx)
+
+    def partitions(self, ctx):
+        lparts = self.children[0].partitions(ctx)
+        rparts = self.children[1].partitions(ctx)
+        assert len(lparts) == len(rparts), \
+            f"join partition mismatch {len(lparts)} vs {len(rparts)}"
+
+        def eval_keys(hb, exprs):
+            cctx = CpuEvalCtx(hb)
+            cols = [e.cpu_eval(cctx).to_column().to_list() for e in exprs]
+            return [tuple(c[i] for c in cols) for i in range(hb.num_rows)]
+
+        def gen(lp, rp):
+            lrows, lkeys = [], []
+            for hb in lp:
+                lrows.extend(_rows(hb))
+                lkeys.extend(eval_keys(hb, self.left_keys))
+            rrows, rkeys = [], []
+            for hb in rp:
+                rrows.extend(_rows(hb))
+                rkeys.extend(eval_keys(hb, self.right_keys))
+            build: Dict[tuple, List[int]] = {}
+            for j, k in enumerate(rkeys):
+                if any(v is None for v in k):
+                    continue
+                build.setdefault(k, []).append(j)
+            out = []
+            l_matched = [False] * len(lrows)
+            r_matched = [False] * len(rrows)
+            semi = self.how in ("left_semi", "left_anti")
+            r_width = len(rrows[0]) if rrows else \
+                len(self.children[1].output_schema)
+            l_width = len(lrows[0]) if lrows else \
+                len(self.children[0].output_schema)
+            for i, k in enumerate(lkeys):
+                matches = [] if any(v is None for v in k) else \
+                    build.get(k, [])
+                for j in matches:
+                    row = lrows[i] + rrows[j]
+                    if self.condition is not None and not \
+                            self._cond(row):
+                        continue
+                    l_matched[i] = True
+                    r_matched[j] = True
+                    if not semi:
+                        out.append(row)
+            if self.how in ("left", "full"):
+                for i in range(len(lrows)):
+                    if not l_matched[i]:
+                        out.append(lrows[i] + (None,) * r_width)
+            if self.how in ("right", "full"):
+                for j in range(len(rrows)):
+                    if not r_matched[j]:
+                        out.append((None,) * l_width + rrows[j])
+            if self.how == "left_semi":
+                out = [lrows[i] for i in range(len(lrows)) if l_matched[i]]
+            if self.how == "left_anti":
+                out = [lrows[i] for i in range(len(lrows)) if not l_matched[i]]
+            if out:
+                yield _from_rows(self.output_schema, out)
+
+        return [gen(lp, rp) for lp, rp in zip(lparts, rparts)]
+
+    def _cond(self, row):
+        # Evaluate the residual condition over a single joined row.
+        sch = self.output_schema
+        hb = _from_rows(sch, [row])
+        v = self.condition.cpu_eval(CpuEvalCtx(hb))
+        return bool(v.validity[0]) and bool(v.values[0])
+
+
+class CpuNestedLoopJoinExec(CpuExec):
+    """Cartesian / conditioned cross join (GpuBroadcastNestedLoopJoinExec +
+    GpuCartesianProductExec fallback)."""
+
+    def __init__(self, left: PhysicalOp, right: PhysicalOp, how: str,
+                 condition: Optional[Expression], schema: T.Schema):
+        super().__init__([left, right], schema)
+        self.how = how
+        self.condition = condition
+
+    def num_partitions(self, ctx):
+        return self.children[0].num_partitions(ctx)
+
+    def partitions(self, ctx):
+        # Broadcast model: right side fully materialized once.
+        rrows = []
+        for p in self.children[1].partitions(ctx):
+            for hb in p:
+                rrows.extend(_rows(hb))
+
+        def gen(lp):
+            out = []
+            for hb in lp:
+                for lrow in _rows(hb):
+                    for rrow in rrows:
+                        row = lrow + rrow
+                        if self.condition is None or self._cond(row):
+                            out.append(row)
+            if out:
+                yield _from_rows(self.output_schema, out)
+
+        return [gen(p) for p in self.children[0].partitions(ctx)]
+
+    def _cond(self, row):
+        hb = _from_rows(self.output_schema, [row])
+        v = self.condition.cpu_eval(CpuEvalCtx(hb))
+        return bool(v.validity[0]) and bool(v.values[0])
+
+
+class CpuExpandExec(CpuExec):
+    def __init__(self, projections: List[List[Expression]], child: PhysicalOp,
+                 schema: T.Schema):
+        super().__init__([child], schema)
+        self.projections = projections
+
+    def partitions(self, ctx):
+        def gen(part):
+            for hb in part:
+                cctx = CpuEvalCtx(hb)
+                for proj in self.projections:
+                    cols = [e.cpu_eval(cctx).to_column() for e in proj]
+                    yield HostBatch(self.output_schema, cols)
+
+        return [gen(p) for p in self.children[0].partitions(ctx)]
+
+
+class CpuSampleExec(CpuExec):
+    def __init__(self, fraction: float, seed: int, child: PhysicalOp):
+        super().__init__([child], child.output_schema)
+        self.fraction = fraction
+        self.seed = seed
+
+    def partitions(self, ctx):
+        def gen(pi, part):
+            rng = np.random.RandomState(self.seed + pi)
+            for hb in part:
+                keep = rng.rand(hb.num_rows) < self.fraction
+                cols = [HostColumn(c.dtype, c.values[keep], c.validity[keep])
+                        for c in hb.columns]
+                out = HostBatch(hb.schema, cols)
+                if out.num_rows:
+                    yield out
+
+        return [gen(i, p)
+                for i, p in enumerate(self.children[0].partitions(ctx))]

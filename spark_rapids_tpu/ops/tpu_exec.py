@@ -1,0 +1,562 @@
+"""TPU physical operators: each one lowers its per-batch work to a jitted XLA
+computation over the pytree :class:`ColumnBatch` (the analogue of the
+reference's cudf-JNI calls inside ``doExecuteColumnar`` closures,
+basicPhysicalOperators.scala:35-141, aggregate.scala:312, GpuSortExec.scala,
+GpuHashJoin.scala).
+
+jit granularity: one compiled program per (exec, schema, capacity-bucket).
+Pipelines of Project/Filter ops fuse naturally because each exec's jit is
+cheap to cache and XLA fuses elementwise chains into single kernels.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.batch import (
+    ColumnBatch, DeviceColumn, HostBatch, empty_device_batch, host_to_device,
+    round_up_capacity,
+)
+from spark_rapids_tpu.exprs.aggregates import AggregateExpression
+from spark_rapids_tpu.exprs.base import DevVal, Expression, SortOrder, TpuEvalCtx
+from spark_rapids_tpu.kernels.groupby import groupby_aggregate
+from spark_rapids_tpu.kernels.join import cross_join, hash_join
+from spark_rapids_tpu.kernels.layout import compact, concat_pair, take_head
+from spark_rapids_tpu.kernels.sort import sort_batch
+from spark_rapids_tpu.plan.physical import ExecContext, PhysicalOp, TpuExec
+
+
+def _concat_all(batches: List[ColumnBatch], schema: T.Schema
+                ) -> Optional[ColumnBatch]:
+    """Concatenate a partition's batches into one (RequireSingleBatch goal,
+    GpuCoalesceBatches.scala:105-110).  Sizes the output by host-visible
+    row totals (one sync per partition — acceptable at pipeline breaks)."""
+    if not batches:
+        return None
+    if len(batches) == 1:
+        return batches[0]
+    total_rows = sum(b.host_num_rows() for b in batches)
+    cap = round_up_capacity(max(total_rows, 1))
+    byte_caps = []
+    for i, f in enumerate(schema.fields):
+        if f.dtype.is_string:
+            tot = 0
+            for b in batches:
+                off = jax.device_get(b.columns[i].offsets)
+                tot += int(off[-1])
+            byte_caps.append(round_up_capacity(max(tot, 16), minimum=16))
+    acc = batches[0]
+    for nxt in batches[1:]:
+        acc = concat_pair(acc, nxt, cap,
+                          out_byte_caps=byte_caps or None)
+    return acc
+
+
+class TpuRangeExec(TpuExec):
+    """GpuRangeExec analogue: generates ids directly in HBM."""
+
+    def __init__(self, start, end, step, num_parts, schema: T.Schema):
+        super().__init__([], schema)
+        self.start, self.end, self.step = start, end, step
+        self._n = max(1, num_parts)
+
+    def num_partitions(self, ctx):
+        return self._n
+
+    def partitions(self, ctx):
+        total = max(0, -(-(self.end - self.start) // self.step))
+        per = -(-total // self._n)
+        max_batch = 1 << 20
+
+        def gen(p):
+            lo_i = self.start + p * per * self.step
+            count = max(0, min(per, total - p * per))
+            done = 0
+            while done < count:
+                n = min(max_batch, count - done)
+                cap = round_up_capacity(n)
+                start = lo_i + done * self.step
+                data = start + jnp.arange(cap, dtype=jnp.int64) * self.step
+                col = DeviceColumn(T.LONG, data,
+                                   jnp.arange(cap, dtype=jnp.int32) < n, None)
+                yield ColumnBatch(self.output_schema, [col],
+                                  jnp.asarray(n, jnp.int32), cap)
+                done += n
+
+        return [gen(p) for p in range(self._n)]
+
+
+class TpuProjectExec(TpuExec):
+    def __init__(self, exprs: List[Expression], child: PhysicalOp,
+                 schema: T.Schema):
+        super().__init__([child], schema)
+        self.exprs = exprs
+
+        @jax.jit
+        def run(batch: ColumnBatch) -> ColumnBatch:
+            ctx = TpuEvalCtx(batch)
+            cols = [e.tpu_eval(ctx).to_column() for e in self.exprs]
+            return ColumnBatch(schema, cols, batch.num_rows, batch.capacity)
+
+        self._run = run
+
+    def describe(self):
+        return f"TpuProject({', '.join(f.name for f in self.output_schema)})"
+
+    def partitions(self, ctx):
+        return [map(self._run, p)
+                for p in self.children[0].partitions(ctx)]
+
+
+class TpuFilterExec(TpuExec):
+    def __init__(self, condition: Expression, child: PhysicalOp):
+        super().__init__([child], child.output_schema)
+        self.condition = condition
+
+        @jax.jit
+        def run(batch: ColumnBatch) -> ColumnBatch:
+            ctx = TpuEvalCtx(batch)
+            v = self.condition.tpu_eval(ctx)
+            keep = v.validity & v.data.astype(jnp.bool_)
+            return compact(batch, keep)
+
+        self._run = run
+
+    def describe(self):
+        return f"TpuFilter({self.condition!r})"
+
+    def partitions(self, ctx):
+        return [map(self._run, p)
+                for p in self.children[0].partitions(ctx)]
+
+
+class TpuUnionExec(TpuExec):
+    def __init__(self, children: List[PhysicalOp], schema: T.Schema):
+        super().__init__(children, schema)
+
+    def num_partitions(self, ctx):
+        return sum(c.num_partitions(ctx) for c in self.children)
+
+    def partitions(self, ctx):
+        out = []
+        for c in self.children:
+            for p in c.partitions(ctx):
+                out.append(self._rename(p))
+        return out
+
+    def _rename(self, part):
+        for db in part:
+            yield ColumnBatch(self.output_schema, db.columns, db.num_rows,
+                              db.capacity)
+
+
+class TpuCoalesceBatchesExec(TpuExec):
+    """Concat small batches up to the target row goal
+    (GpuCoalesceBatches.scala:115; the hot path for downstream op
+    efficiency)."""
+
+    def __init__(self, child: PhysicalOp, target_rows: int = 1 << 20):
+        super().__init__([child], child.output_schema)
+        self.target_rows = target_rows
+
+    def partitions(self, ctx):
+        def gen(part):
+            pending: List[ColumnBatch] = []
+            pending_rows = 0
+            for db in part:
+                n = db.host_num_rows()
+                if n == 0:
+                    continue
+                if pending_rows + n > self.target_rows and pending:
+                    out = _concat_all(pending, self.output_schema)
+                    if out is not None:
+                        yield out
+                    pending, pending_rows = [], 0
+                pending.append(db)
+                pending_rows += n
+            out = _concat_all(pending, self.output_schema)
+            if out is not None:
+                yield out
+
+        return [gen(p) for p in self.children[0].partitions(ctx)]
+
+
+class TpuLocalLimitExec(TpuExec):
+    def __init__(self, n: int, child: PhysicalOp):
+        super().__init__([child], child.output_schema)
+        self.n = n
+
+    def partitions(self, ctx):
+        def gen(part):
+            left = self.n
+            for db in part:
+                if left <= 0:
+                    break
+                db = take_head(db, left)
+                got = db.host_num_rows()
+                left -= got
+                if got:
+                    yield db
+
+        return [gen(p) for p in self.children[0].partitions(ctx)]
+
+
+class TpuSortExec(TpuExec):
+    """Whole-partition sort (cudf Table.orderBy analogue).  Requires a single
+    batch, so it concats first — like the reference's RequireSingleBatch goal
+    for global sorts (GpuSortExec.scala:50-98)."""
+
+    def __init__(self, orders: List[SortOrder], key_exprs: List[Expression],
+                 child: PhysicalOp):
+        super().__init__([child], child.output_schema)
+        self.orders = orders
+        self.key_exprs = key_exprs
+
+        @jax.jit
+        def run(batch: ColumnBatch) -> ColumnBatch:
+            ctx = TpuEvalCtx(batch)
+            vals = [e.tpu_eval(ctx) for e in self.key_exprs]
+            return sort_batch(batch, vals,
+                              [o.ascending for o in self.orders],
+                              [o.nulls_first for o in self.orders])
+
+        self._run = run
+
+    def describe(self):
+        return f"TpuSort({len(self.orders)} keys)"
+
+    def partitions(self, ctx):
+        def gen(part):
+            merged = _concat_all(list(part), self.output_schema)
+            if merged is not None:
+                yield self._run(merged)
+
+        return [gen(p) for p in self.children[0].partitions(ctx)]
+
+
+def _buffer_schema(key_names: List[str], keys: List[Expression],
+                   aggs: List[AggregateExpression]) -> T.Schema:
+    fields = [T.Field(n, e.dtype, e.nullable)
+              for n, e in zip(key_names, keys)]
+    for i, a in enumerate(aggs):
+        for j, spec in enumerate(a.fn.buffers()):
+            fields.append(T.Field(f"__buf_{i}_{j}", spec.dtype, True))
+    return T.Schema(fields)
+
+
+class TpuHashAggregateExec(TpuExec):
+    """Sort-based groupby aggregation, two-mode (update/merge) like the
+    reference's Partial/Final plumbing (aggregate.scala:420-524).
+
+    mode="update": raw rows -> per-partition partial batch
+                   (group keys + agg buffers).
+    mode="merge":  partial batches (post-exchange) -> merged groups ->
+                   finalized output projection.
+    """
+
+    def __init__(self, mode: str, key_exprs: List[Expression],
+                 key_names: List[str], aggs: List[AggregateExpression],
+                 child: PhysicalOp, schema: T.Schema):
+        assert mode in ("update", "merge")
+        super().__init__([child], schema)
+        self.mode = mode
+        self.key_exprs = key_exprs
+        self.key_names = key_names
+        self.aggs = aggs
+        self.key_schema = T.Schema([
+            T.Field(n, e.dtype, e.nullable)
+            for n, e in zip(key_names, key_exprs)
+        ])
+        self.buffer_schemas = [[s.dtype for s in a.fn.buffers()]
+                               for a in aggs]
+
+        @jax.jit
+        def run(batch: ColumnBatch) -> ColumnBatch:
+            return self._aggregate_batch(batch)
+
+        self._run = run
+        self._merge_run = jax.jit(self._merge_partials)
+
+    def describe(self):
+        return f"TpuHashAggregate({self.mode}, keys={len(self.key_exprs)})"
+
+    # -- core ---------------------------------------------------------------
+
+    def _eval_keys(self, batch) -> List[DevVal]:
+        if self.mode == "update":
+            ctx = TpuEvalCtx(batch)
+            return [e.tpu_eval(ctx) for e in self.key_exprs]
+        # merge mode: keys are the leading child columns by position
+        return [DevVal.from_column(batch.columns[i])
+                for i in range(len(self.key_exprs))]
+
+    def _synth_key(self, batch) -> List[DevVal]:
+        """Zero grouping keys (global reduction): constant key, one group."""
+        cap = batch.capacity
+        return [DevVal(T.INT, jnp.zeros(cap, dtype=jnp.int32),
+                       jnp.ones(cap, dtype=jnp.bool_))]
+
+    def _aggregate_batch(self, batch: ColumnBatch) -> ColumnBatch:
+        keyless = not self.key_exprs
+        key_vals = self._synth_key(batch) if keyless else \
+            self._eval_keys(batch)
+        key_schema = T.Schema([("__k", T.INT)]) if keyless else \
+            self.key_schema
+
+        if self.mode == "update":
+            ctx = TpuEvalCtx(batch)
+            agg_inputs = [a.fn.child.tpu_eval(ctx) for a in self.aggs]
+            merge = False
+        else:
+            nk = len(self.key_exprs) if not keyless else 0
+            agg_inputs = []
+            i = nk
+            for bufs in self.buffer_schemas:
+                for _ in bufs:
+                    agg_inputs.append(DevVal.from_column(batch.columns[i]))
+                    i += 1
+            merge = True
+
+        group_keys, buffers = groupby_aggregate(
+            batch, key_vals, agg_inputs, [a.fn for a in self.aggs], merge,
+            key_schema, self.buffer_schemas, self.output_schema)
+
+        num_groups = group_keys.num_rows
+        if keyless:
+            # A reduction always emits exactly one row; empty input yields
+            # the identity buffers -> SQL defaults (count=0, sum=NULL...).
+            num_groups = jnp.asarray(1, jnp.int32)
+        cap = batch.capacity
+
+        if self.mode == "update":
+            cols = [] if keyless else list(group_keys.columns)
+            for bufs in buffers:
+                for b in bufs:
+                    cols.append(DeviceColumn(b.dtype, b.data,
+                                             b.validity, b.offsets))
+            return ColumnBatch(self.output_schema, cols, num_groups, cap)
+
+        # merge mode: finalize each agg into its output column
+        cols = [] if keyless else list(group_keys.columns)
+        for a, bufs in zip(self.aggs, buffers):
+            v = a.fn.finalize(bufs)
+            cols.append(DeviceColumn(v.dtype, v.data, v.validity, v.offsets))
+        return ColumnBatch(self.output_schema, cols, num_groups, cap)
+
+    def partitions(self, ctx):
+        child_schema = self.children[0].output_schema
+
+        if self.mode == "merge":
+            # Inputs are partial-buffer batches (post-exchange): concat the
+            # whole partition FIRST, then merge+finalize once.  Re-merging
+            # finalized outputs would be wrong (avg, first/last...).
+            def gen(part):
+                merged = _concat_all(list(part), child_schema)
+                if merged is None:
+                    if self.key_exprs:
+                        return
+                    # keyless reduction on empty input -> SQL default row
+                    merged = empty_device_batch(child_schema)
+                yield self._run(merged)
+        else:
+            # update mode: aggregate each batch, then combine this
+            # partition's partials: concat + buffer-merge (the reference's
+            # concatenateBatches + merge-aggregate loop,
+            # aggregate.scala:434-492).
+            def gen(part):
+                partials = [self._run(db) for db in part
+                            if db.host_num_rows()]
+                if not partials:
+                    return
+                if len(partials) == 1:
+                    yield partials[0]
+                    return
+                merged = _concat_all(partials, self.output_schema)
+                yield self._merge_run(merged)
+
+        return [gen(p) for p in self.children[0].partitions(ctx)]
+
+    def _merge_partials(self, merged: ColumnBatch) -> ColumnBatch:
+        """Merge concatenated update-mode outputs back to one partial batch
+        per partition (keys + buffers -> keys + buffers)."""
+        keyless = not self.key_exprs
+        key_vals = self._synth_key(merged) if keyless else [
+            DevVal.from_column(merged.columns[i])
+            for i in range(len(self.key_exprs))
+        ]
+        key_schema = T.Schema([("__k", T.INT)]) if keyless else \
+            self.key_schema
+        nk = 0 if keyless else len(self.key_exprs)
+        agg_inputs = []
+        i = nk
+        for bufs in self.buffer_schemas:
+            for _ in bufs:
+                agg_inputs.append(DevVal.from_column(merged.columns[i]))
+                i += 1
+        group_keys, buffers = groupby_aggregate(
+            merged, key_vals, agg_inputs, [a.fn for a in self.aggs], True,
+            key_schema, self.buffer_schemas, self.output_schema)
+        num_groups = group_keys.num_rows
+        if keyless:
+            num_groups = jnp.asarray(1, jnp.int32)
+        cols = [] if keyless else list(group_keys.columns)
+        for bufs in buffers:
+            for b in bufs:
+                cols.append(DeviceColumn(b.dtype, b.data, b.validity,
+                                         b.offsets))
+        return ColumnBatch(self.output_schema, cols, num_groups,
+                           merged.capacity)
+
+
+class TpuShuffledHashJoinExec(TpuExec):
+    """Equi-join per co-partitioned pair (GpuShuffledHashJoinExec analogue).
+    Residual conditions are applied as a post-join filter for inner joins
+    (GpuHashJoin.scala:265-271); outer+condition falls back at planning."""
+
+    def __init__(self, left: PhysicalOp, right: PhysicalOp,
+                 left_keys: List[Expression], right_keys: List[Expression],
+                 how: str, condition: Optional[Expression],
+                 schema: T.Schema):
+        super().__init__([left, right], schema)
+        self.left_keys = left_keys
+        self.right_keys = right_keys
+        self.how = how
+        self.condition = condition
+
+    def describe(self):
+        return f"TpuShuffledHashJoin({self.how})"
+
+    def num_partitions(self, ctx):
+        return self.children[0].num_partitions(ctx)
+
+    def partitions(self, ctx):
+        lparts = self.children[0].partitions(ctx)
+        rparts = self.children[1].partitions(ctx)
+        assert len(lparts) == len(rparts)
+
+        def gen(lp, rp):
+            lb = _concat_all(list(lp), self.children[0].output_schema)
+            rb = _concat_all(list(rp), self.children[1].output_schema)
+            out = self._join_pair(lb, rb)
+            if out is not None:
+                yield out
+
+        return [gen(lp, rp) for lp, rp in zip(lparts, rparts)]
+
+    def _join_pair(self, lb, rb) -> Optional[ColumnBatch]:
+        lsch = self.children[0].output_schema
+        rsch = self.children[1].output_schema
+        if lb is None and self.how in ("inner", "left", "left_semi",
+                                       "left_anti", "cross"):
+            return None
+        if lb is None:
+            lb = empty_device_batch(lsch)
+        if rb is None:
+            if self.how in ("inner", "right", "cross", "left_semi"):
+                if self.how in ("inner", "right", "cross"):
+                    return None
+                # left_semi with empty right = empty
+                return None
+            rb = empty_device_batch(rsch)
+        lctx = TpuEvalCtx(lb)
+        rctx = TpuEvalCtx(rb)
+        lkeys = [e.tpu_eval(lctx) for e in self.left_keys]
+        rkeys = [e.tpu_eval(rctx) for e in self.right_keys]
+        out = hash_join(lb, lkeys, rb, rkeys, self.how, self.output_schema)
+        if self.condition is not None:
+            cctx = TpuEvalCtx(out)
+            v = self.condition.tpu_eval(cctx)
+            out = compact(out, v.validity & v.data.astype(jnp.bool_))
+        return out
+
+
+class TpuNestedLoopJoinExec(TpuExec):
+    """Cross join with optional condition-as-filter (inner/cross only);
+    right side broadcast-materialized (GpuBroadcastNestedLoopJoinExec +
+    GpuCartesianProductExec analogue)."""
+
+    def __init__(self, left: PhysicalOp, right: PhysicalOp,
+                 condition: Optional[Expression], schema: T.Schema):
+        super().__init__([left, right], schema)
+        self.condition = condition
+
+    def num_partitions(self, ctx):
+        return self.children[0].num_partitions(ctx)
+
+    def partitions(self, ctx):
+        rbatches = []
+        for p in self.children[1].partitions(ctx):
+            rbatches.extend(p)
+        rb = _concat_all(rbatches, self.children[1].output_schema)
+
+        def gen(lp):
+            for lb in lp:
+                if rb is None:
+                    return
+                out = cross_join(lb, rb, self.output_schema)
+                if self.condition is not None:
+                    cctx = TpuEvalCtx(out)
+                    v = self.condition.tpu_eval(cctx)
+                    out = compact(out, v.validity & v.data.astype(jnp.bool_))
+                yield out
+
+        return [gen(p) for p in self.children[0].partitions(ctx)]
+
+
+class TpuExpandExec(TpuExec):
+    """Grouping-sets expansion via repeated projections
+    (GpuExpandExec.scala)."""
+
+    def __init__(self, projections: List[List[Expression]], child: PhysicalOp,
+                 schema: T.Schema):
+        super().__init__([child], schema)
+        self.projections = projections
+        self._runs = []
+        for proj in projections:
+            def make(proj=proj):
+                @jax.jit
+                def run(batch):
+                    ctx = TpuEvalCtx(batch)
+                    cols = [e.tpu_eval(ctx).to_column() for e in proj]
+                    return ColumnBatch(schema, cols, batch.num_rows,
+                                       batch.capacity)
+                return run
+            self._runs.append(make())
+
+    def partitions(self, ctx):
+        def gen(part):
+            for db in part:
+                for run in self._runs:
+                    yield run(db)
+
+        return [gen(p) for p in self.children[0].partitions(ctx)]
+
+
+class TpuSampleExec(TpuExec):
+    """Bernoulli sample.  Uses the same host RNG stream as the CPU exec so
+    CPU-vs-TPU compare tests agree."""
+
+    def __init__(self, fraction: float, seed: int, child: PhysicalOp):
+        super().__init__([child], child.output_schema)
+        self.fraction = fraction
+        self.seed = seed
+
+    def partitions(self, ctx):
+        def gen(pi, part):
+            rng = np.random.RandomState(self.seed + pi)
+            for db in part:
+                n = db.host_num_rows()
+                keep_host = rng.rand(n) < self.fraction
+                keep = jnp.zeros(db.capacity, dtype=jnp.bool_).at[:n].set(
+                    jnp.asarray(keep_host))
+                out = compact(db, keep)
+                yield out
+
+        return [gen(i, p)
+                for i, p in enumerate(self.children[0].partitions(ctx))]

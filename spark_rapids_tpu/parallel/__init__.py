@@ -1,0 +1,5 @@
+"""Partitioning, exchanges and the device-mesh shuffle (reference: SURVEY.md
+sections 2.5 partitioning + 2.7 shuffle).  The single-host path regroups
+batches between partition iterators; the multi-chip path shards batches over a
+``jax.sharding.Mesh`` and exchanges rows with an XLA all-to-all inside
+``shard_map`` (the ICI analogue of the reference's UCX transport)."""

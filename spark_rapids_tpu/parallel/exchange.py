@@ -1,0 +1,171 @@
+"""Shuffle and broadcast exchanges (reference: GpuShuffleExchangeExec.scala,
+GpuBroadcastExchangeExec.scala; SURVEY.md sections 2.5, 2.7).
+
+Single-host model: an exchange materializes its child's partitions, splits
+every batch by target-partition id (device-side compaction for TPU plans,
+numpy for CPU fallback), and regroups — the "fallback path (a)" of the
+reference.  The device-mesh all-to-all path (ICI analogue) lives in
+``parallel.mesh_shuffle`` and is used by the distributed runner.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.batch import (
+    ColumnBatch, HostBatch, HostColumn, device_to_host,
+)
+from spark_rapids_tpu.kernels.layout import compact
+from spark_rapids_tpu.parallel.partitioning import (
+    Partitioning, RangePartitioning, SinglePartitioning,
+)
+from spark_rapids_tpu.plan.physical import (
+    CpuExec, ExecContext, PhysicalOp, TpuExec,
+)
+
+_RANGE_SAMPLE_ROWS = 4096
+
+
+class CpuShuffleExchangeExec(CpuExec):
+    def __init__(self, partitioning: Partitioning, child: PhysicalOp):
+        super().__init__([child], child.output_schema)
+        self.partitioning = partitioning
+
+    def describe(self):
+        p = self.partitioning
+        return f"CpuShuffleExchange({type(p).__name__}, {p.num_partitions})"
+
+    def num_partitions(self, ctx):
+        return self.partitioning.num_partitions
+
+    def partitions(self, ctx):
+        n = self.partitioning.num_partitions
+        in_parts = self.children[0].partitions(ctx)
+        all_batches: List[List[HostBatch]] = [list(p) for p in in_parts]
+        if isinstance(self.partitioning, RangePartitioning):
+            self.partitioning.prepare(_sample_host_keys(
+                all_batches, self.partitioning.key_ordinals))
+        out: List[List[HostBatch]] = [[] for _ in range(n)]
+        for pi, batches in enumerate(all_batches):
+            for hb in batches:
+                ids = self.partitioning.host_partition_ids(hb, pi)
+                for p in range(n):
+                    keep = ids == p
+                    if not keep.any():
+                        continue
+                    cols = [HostColumn(c.dtype, c.values[keep],
+                                       c.validity[keep])
+                            for c in hb.columns]
+                    out[p].append(HostBatch(hb.schema, cols))
+        return [iter(p) for p in out]
+
+
+def _sample_host_keys(all_batches: List[List[HostBatch]],
+                      key_ordinals: List[int]) -> List[tuple]:
+    rows: List[tuple] = []
+    for batches in all_batches:
+        for hb in batches:
+            cols = [hb.columns[i].to_list() for i in key_ordinals]
+            for r in range(hb.num_rows):
+                rows.append(tuple(c[r] for c in cols))
+                if len(rows) >= _RANGE_SAMPLE_ROWS:
+                    return rows
+    return rows
+
+
+class TpuShuffleExchangeExec(TpuExec):
+    """Device-side partition split: pid per row (murmur3 pmod / range
+    compare / round-robin), then one compaction per target partition —
+    the single-host analogue of GPU partition + contiguousSplit
+    (GpuPartitioning.scala:44-117)."""
+
+    def __init__(self, partitioning: Partitioning, child: PhysicalOp):
+        super().__init__([child], child.output_schema)
+        self.partitioning = partitioning
+        self._split = jax.jit(self._split_impl, static_argnames=("n",))
+
+    def describe(self):
+        p = self.partitioning
+        return f"TpuShuffleExchange({type(p).__name__}, {p.num_partitions})"
+
+    def num_partitions(self, ctx):
+        return self.partitioning.num_partitions
+
+    def _split_impl(self, batch: ColumnBatch, part_index, n: int):
+        ids = self.partitioning.device_partition_ids(batch, part_index)
+        return [compact(batch, ids == p) for p in range(n)]
+
+    def partitions(self, ctx):
+        n = self.partitioning.num_partitions
+        in_parts = self.children[0].partitions(ctx)
+        all_batches: List[List[ColumnBatch]] = [list(p) for p in in_parts]
+        if isinstance(self.partitioning, RangePartitioning):
+            self.partitioning.prepare(
+                _sample_device_keys(all_batches,
+                                    self.partitioning.key_ordinals))
+        if isinstance(self.partitioning, SinglePartitioning):
+            flat = [b for part in all_batches for b in part]
+            return [iter(flat)]
+        out: List[List[ColumnBatch]] = [[] for _ in range(n)]
+        rows_metric = ctx.metric(self.op_id, "partitionRows")
+        for pi, batches in enumerate(all_batches):
+            for db in batches:
+                pieces = self._split(db, pi, n) \
+                    if not isinstance(self.partitioning, RangePartitioning) \
+                    else self._split_impl(db, pi, n)
+                for p in range(n):
+                    out[p].append(pieces[p])
+        return [iter(p) for p in out]
+
+
+def _sample_device_keys(all_batches: List[List[ColumnBatch]],
+                        key_ordinals: List[int]) -> List[tuple]:
+    rows: List[tuple] = []
+    for batches in all_batches:
+        for db in batches:
+            sub = ColumnBatch(
+                T.Schema([db.schema.fields[i] for i in key_ordinals]),
+                [db.columns[i] for i in key_ordinals], db.num_rows,
+                db.capacity)
+            hb = device_to_host(sub)
+            cols = [c.to_list() for c in hb.columns]
+            for r in range(hb.num_rows):
+                rows.append(tuple(c[r] for c in cols))
+                if len(rows) >= _RANGE_SAMPLE_ROWS:
+                    return rows
+    return rows
+
+
+class CpuBroadcastExchangeExec(CpuExec):
+    """Materialize the whole child once; every consumer partition sees the
+    same single host batch (driver-side broadcast analogue,
+    GpuBroadcastExchangeExec.scala:53-135)."""
+
+    def __init__(self, child: PhysicalOp):
+        super().__init__([child], child.output_schema)
+        self._cached = None
+
+    def num_partitions(self, ctx):
+        return 1
+
+    def materialize(self, ctx) -> HostBatch:
+        if self._cached is None:
+            batches = []
+            for p in self.children[0].partitions(ctx):
+                batches.extend(p)
+            if batches:
+                self._cached = HostBatch.concat(batches)
+            else:
+                from spark_rapids_tpu.plan.physical import _empty_host_col
+                self._cached = HostBatch(self.output_schema, [
+                    _empty_host_col(f) for f in self.output_schema.fields
+                ])
+        return self._cached
+
+    def partitions(self, ctx):
+        return [iter([self.materialize(ctx)])]

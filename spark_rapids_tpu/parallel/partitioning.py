@@ -1,0 +1,178 @@
+"""Partitioning strategies (reference: GpuHashPartitioning.scala,
+GpuRangePartitioner.scala, GpuRoundRobinPartitioning.scala,
+GpuSinglePartitioning.scala — SURVEY.md section 2.5).
+
+Each strategy computes a target-partition id per row, on device (for TPU
+exchanges) and on host (CPU exchanges + oracle).  Hash partitioning is
+Spark-compatible murmur3 pmod, so CPU and TPU place every row identically —
+required for mixed CPU/TPU plans to line up at joins.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.batch import ColumnBatch, HostBatch
+from spark_rapids_tpu.exprs.base import (
+    CpuEvalCtx, Expression, SortOrder, TpuEvalCtx,
+)
+from spark_rapids_tpu.exprs.hashing import murmur3_cols, murmur3_cols_cpu
+
+
+class Partitioning:
+    num_partitions: int = 1
+
+    def device_partition_ids(self, batch: ColumnBatch, part_index: int):
+        raise NotImplementedError
+
+    def host_partition_ids(self, batch: HostBatch, part_index: int):
+        raise NotImplementedError
+
+    def prepare(self, sample_rows_fn):
+        """Hook for strategies needing a pre-pass over the data (range)."""
+
+
+@dataclasses.dataclass
+class SinglePartitioning(Partitioning):
+    num_partitions: int = 1
+
+    def device_partition_ids(self, batch, part_index):
+        return jnp.zeros(batch.capacity, dtype=jnp.int32)
+
+    def host_partition_ids(self, batch, part_index):
+        return np.zeros(batch.num_rows, dtype=np.int32)
+
+
+@dataclasses.dataclass
+class HashPartitioning(Partitioning):
+    keys: List[Expression]
+    num_partitions: int
+
+    def device_partition_ids(self, batch, part_index):
+        ctx = TpuEvalCtx(batch)
+        vals = [k.tpu_eval(ctx) for k in self.keys]
+        h = murmur3_cols(vals)  # int32, Spark-compatible
+        n = jnp.int32(self.num_partitions)
+        return ((h % n) + n) % n  # pmod
+
+    def host_partition_ids(self, batch, part_index):
+        ctx = CpuEvalCtx(batch)
+        vals = [k.cpu_eval(ctx) for k in self.keys]
+        h = murmur3_cols_cpu(vals)
+        n = np.int32(self.num_partitions)
+        return ((h % n) + n) % n
+
+
+@dataclasses.dataclass
+class RoundRobinPartitioning(Partitioning):
+    num_partitions: int
+
+    def device_partition_ids(self, batch, part_index):
+        start = jnp.int32(part_index)
+        return (start + jnp.arange(batch.capacity, dtype=jnp.int32)) \
+            % jnp.int32(self.num_partitions)
+
+    def host_partition_ids(self, batch, part_index):
+        return (part_index + np.arange(batch.num_rows, dtype=np.int32)) \
+            % np.int32(self.num_partitions)
+
+
+class RangePartitioning(Partitioning):
+    """Sample-based range bounds (GpuRangePartitioner analogue).  Bounds are
+    computed host-side from a sample by the exchange, then broadcast into the
+    row->partition comparison (device: lexicographic compare against encoded
+    bound words)."""
+
+    def __init__(self, orders: List[SortOrder], key_ordinals: List[int],
+                 num_partitions: int):
+        self.orders = orders
+        self.key_ordinals = key_ordinals
+        self.num_partitions = num_partitions
+        self.bound_rows: Optional[List[tuple]] = None  # host key tuples
+
+    def prepare(self, sample_rows):
+        """sample_rows: list of key tuples sampled from the input."""
+        from spark_rapids_tpu.ops.cpu_exec import sort_key_fn
+        n = self.num_partitions
+        if not sample_rows or n <= 1:
+            self.bound_rows = []
+            return
+        key = sort_key_fn(self.orders, list(range(len(self.orders))))
+        ordered = sorted(sample_rows, key=key)
+        bounds = []
+        for i in range(1, n):
+            idx = min(len(ordered) - 1, (i * len(ordered)) // n)
+            bounds.append(ordered[idx])
+        self.bound_rows = bounds
+
+    def _host_cmp_le(self, row_key, bound) -> bool:
+        from spark_rapids_tpu.ops.cpu_exec import sort_key_fn
+        key = sort_key_fn(self.orders, list(range(len(self.orders))))
+        return key(row_key) <= key(bound)
+
+    def host_partition_ids(self, batch, part_index):
+        assert self.bound_rows is not None, "range bounds not prepared"
+        ids = np.zeros(batch.num_rows, dtype=np.int32)
+        cols = [batch.columns[i].to_list() for i in self.key_ordinals]
+        from spark_rapids_tpu.ops.cpu_exec import sort_key_fn
+        keyf = sort_key_fn(self.orders, list(range(len(self.orders))))
+        enc_bounds = [keyf(b) for b in self.bound_rows]
+        for r in range(batch.num_rows):
+            rk = keyf(tuple(c[r] for c in cols))
+            p = 0
+            for b in enc_bounds:
+                if rk > b:
+                    p += 1
+                else:
+                    break
+            ids[r] = p
+        return ids
+
+    def device_partition_ids(self, batch, part_index):
+        assert self.bound_rows is not None, "range bounds not prepared"
+        from spark_rapids_tpu.exprs.base import DevVal
+        from spark_rapids_tpu.kernels.sortkeys import encode_sort_keys
+        cap = batch.capacity
+        vals = [DevVal.from_column(batch.columns[i])
+                for i in self.key_ordinals]
+        ascs = [o.ascending for o in self.orders]
+        nfs = [o.nulls_first for o in self.orders]
+        words = encode_sort_keys(vals, ascs, nfs, batch.num_rows)[1:]
+        # words[0] (liveness) dropped: padding rows' pid is masked later.
+        pid = jnp.zeros(cap, dtype=jnp.int32)
+        for bound in self.bound_rows:
+            bwords = self._encode_bound(bound)
+            # row > bound (lexicographic over words)?
+            gt = jnp.zeros(cap, dtype=jnp.bool_)
+            eq = jnp.ones(cap, dtype=jnp.bool_)
+            for w, bw in zip(words, bwords):
+                gt = gt | (eq & (w > bw))
+                eq = eq & (w == bw)
+            pid = pid + gt.astype(jnp.int32)
+        return pid
+
+    def _encode_bound(self, bound: tuple) -> List[np.uint64]:
+        """Encode one host bound row with the same word scheme as
+        encode_sort_keys (minus the liveness word)."""
+        from spark_rapids_tpu.batch import HostBatch, HostColumn, \
+            host_to_device
+        from spark_rapids_tpu.exprs.base import DevVal
+        from spark_rapids_tpu.kernels.sortkeys import encode_sort_keys
+        fields = []
+        cols = []
+        for i, (o, v) in enumerate(zip(self.orders, bound)):
+            dt = o.child.dtype
+            fields.append((f"b{i}", dt))
+            cols.append(HostColumn.from_list(dt, [v]))
+        hb = HostBatch(T.Schema(fields), cols)
+        db = host_to_device(hb, capacity=1)
+        vals = [DevVal.from_column(c) for c in db.columns]
+        ascs = [o.ascending for o in self.orders]
+        nfs = [o.nulls_first for o in self.orders]
+        words = encode_sort_keys(vals, ascs, nfs, db.num_rows)[1:]
+        return [w[0] for w in words]

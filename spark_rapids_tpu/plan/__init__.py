@@ -1,0 +1,3 @@
+"""Query planning: logical plan, physical operators, and the TPU-overrides
+plan-rewrite machinery (reference: GpuOverrides.scala / RapidsMeta.scala /
+GpuTransitionOverrides.scala, SURVEY.md section 2.2)."""

@@ -1,0 +1,328 @@
+"""Logical plan nodes built by the DataFrame frontend.
+
+The reference accelerates Spark's physical plans; here the frontend owns the
+whole stack, so this logical layer plays Catalyst's role: a typed operator
+tree that the physical planner lowers to CPU/TPU execs.  Node set mirrors the
+exec inventory of SURVEY.md section 2.5.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exprs.aggregates import AggregateExpression
+from spark_rapids_tpu.exprs.base import Expression, SortOrder
+
+
+class LogicalPlan:
+    children: Tuple["LogicalPlan", ...] = ()
+
+    @property
+    def schema(self) -> T.Schema:
+        raise NotImplementedError
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def tree_string(self, depth: int = 0) -> str:
+        out = "  " * depth + self.describe() + "\n"
+        for c in self.children:
+            out += c.tree_string(depth + 1)
+        return out
+
+    def describe(self) -> str:
+        return self.name
+
+
+class InMemoryScan(LogicalPlan):
+    """Scan over host-resident batches (createDataFrame / test input)."""
+
+    def __init__(self, batches: List, schema: T.Schema,
+                 num_partitions: int = 1):
+        self.batches = batches  # List[HostBatch]
+        self._schema = schema
+        self.num_partitions = num_partitions
+        self.children = ()
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return f"InMemoryScan({self._schema})"
+
+
+class FileScan(LogicalPlan):
+    """File-source scan (parquet/csv/orc); decode happens host-side, staged
+    to HBM by the physical scan exec (GpuParquetScan analogue)."""
+
+    def __init__(self, fmt: str, paths: List[str], schema: T.Schema,
+                 options: Optional[Dict[str, Any]] = None,
+                 pushed_filters: Optional[List[Expression]] = None):
+        self.fmt = fmt
+        self.paths = paths
+        self._schema = schema
+        self.options = options or {}
+        self.pushed_filters = pushed_filters or []
+        self.children = ()
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return f"FileScan({self.fmt}, {len(self.paths)} files)"
+
+
+class Range(LogicalPlan):
+    """spark.range() analogue (GpuRangeExec)."""
+
+    def __init__(self, start: int, end: int, step: int = 1,
+                 num_partitions: int = 1, name: str = "id"):
+        self.start, self.end, self.step = start, end, step
+        self.num_partitions = num_partitions
+        self.col_name = name
+        self.children = ()
+
+    @property
+    def schema(self):
+        return T.Schema([(self.col_name, T.LONG)])
+
+    def describe(self):
+        return f"Range({self.start}, {self.end}, {self.step})"
+
+
+class Project(LogicalPlan):
+    def __init__(self, exprs: List[Expression], names: List[str],
+                 child: LogicalPlan):
+        self.exprs = exprs
+        self.names = names
+        self.children = (child,)
+
+    @property
+    def schema(self):
+        return T.Schema([
+            T.Field(n, e.dtype, e.nullable)
+            for n, e in zip(self.names, self.exprs)
+        ])
+
+    def describe(self):
+        return f"Project({', '.join(self.names)})"
+
+
+class Filter(LogicalPlan):
+    def __init__(self, condition: Expression, child: LogicalPlan):
+        self.condition = condition
+        self.children = (child,)
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def describe(self):
+        return f"Filter({self.condition!r})"
+
+
+class Aggregate(LogicalPlan):
+    """Groupby aggregation; empty ``keys`` = global reduction."""
+
+    def __init__(self, keys: List[Expression], key_names: List[str],
+                 aggs: List[AggregateExpression], child: LogicalPlan):
+        self.keys = keys
+        self.key_names = key_names
+        self.aggs = aggs
+        self.children = (child,)
+
+    @property
+    def schema(self):
+        fields = [T.Field(n, e.dtype, e.nullable)
+                  for n, e in zip(self.key_names, self.keys)]
+        fields += [T.Field(a.output_name, a.dtype, True) for a in self.aggs]
+        return T.Schema(fields)
+
+    def describe(self):
+        return (f"Aggregate(keys=[{', '.join(self.key_names)}], "
+                f"aggs=[{', '.join(a.output_name for a in self.aggs)}])")
+
+
+class Sort(LogicalPlan):
+    def __init__(self, orders: List[SortOrder], is_global: bool,
+                 child: LogicalPlan):
+        self.orders = orders
+        self.is_global = is_global
+        self.children = (child,)
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def describe(self):
+        g = "global" if self.is_global else "local"
+        return f"Sort({g}, {len(self.orders)} keys)"
+
+
+class Join(LogicalPlan):
+    JOIN_TYPES = ("inner", "left", "right", "full", "left_semi", "left_anti",
+                  "cross")
+
+    def __init__(self, left: LogicalPlan, right: LogicalPlan,
+                 left_keys: List[Expression], right_keys: List[Expression],
+                 how: str, condition: Optional[Expression] = None):
+        assert how in self.JOIN_TYPES, how
+        self.left_keys = left_keys
+        self.right_keys = right_keys
+        self.how = how
+        self.condition = condition
+        self.children = (left, right)
+
+    @property
+    def schema(self):
+        left, right = self.children
+        if self.how in ("left_semi", "left_anti"):
+            return left.schema
+        lfields = list(left.schema.fields)
+        rfields = list(right.schema.fields)
+        if self.how in ("left", "full"):
+            rfields = [T.Field(f.name, f.dtype, True) for f in rfields]
+        if self.how in ("right", "full"):
+            lfields = [T.Field(f.name, f.dtype, True) for f in lfields]
+        return T.Schema(lfields + rfields)
+
+    def describe(self):
+        return f"Join({self.how})"
+
+
+class Union(LogicalPlan):
+    def __init__(self, children: Sequence[LogicalPlan]):
+        self.children = tuple(children)
+        s0 = self.children[0].schema
+        for c in self.children[1:]:
+            assert [f.dtype for f in c.schema.fields] == \
+                [f.dtype for f in s0.fields], "union schema mismatch"
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+
+class Limit(LogicalPlan):
+    def __init__(self, n: int, child: LogicalPlan):
+        self.n = n
+        self.children = (child,)
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def describe(self):
+        return f"Limit({self.n})"
+
+
+class Distinct(LogicalPlan):
+    def __init__(self, child: LogicalPlan):
+        self.children = (child,)
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+
+class Expand(LogicalPlan):
+    """Grouping-sets expansion: each projection list emits one output row set
+    (GpuExpandExec analogue)."""
+
+    def __init__(self, projections: List[List[Expression]], names: List[str],
+                 child: LogicalPlan):
+        self.projections = projections
+        self.names = names
+        self.children = (child,)
+
+    @property
+    def schema(self):
+        p0 = self.projections[0]
+        return T.Schema([
+            T.Field(n, e.dtype, True) for n, e in zip(self.names, p0)
+        ])
+
+
+class Generate(LogicalPlan):
+    """explode/posexplode over a per-row repetition (GpuGenerateExec
+    analogue).  Round 1: explode of a literal-bounded sequence column model;
+    array types land with nested-type support."""
+
+    def __init__(self, generator, output_names: List[str], child: LogicalPlan):
+        self.generator = generator
+        self.output_names = output_names
+        self.children = (child,)
+
+    @property
+    def schema(self):
+        base = list(self.children[0].schema.fields)
+        gen = [T.Field(n, t, True)
+               for n, t in zip(self.output_names, self.generator.output_types)]
+        return T.Schema(base + gen)
+
+
+class Window(LogicalPlan):
+    def __init__(self, window_exprs, output_names: List[str],
+                 child: LogicalPlan):
+        self.window_exprs = window_exprs
+        self.output_names = output_names
+        self.children = (child,)
+
+    @property
+    def schema(self):
+        base = list(self.children[0].schema.fields)
+        extra = [T.Field(n, w.dtype, True)
+                 for n, w in zip(self.output_names, self.window_exprs)]
+        return T.Schema(base + extra)
+
+
+class Repartition(LogicalPlan):
+    """Explicit exchange: mode in {hash, roundrobin, range, single}."""
+
+    def __init__(self, mode: str, num_partitions: int,
+                 keys: List[Expression], child: LogicalPlan,
+                 orders: Optional[List[SortOrder]] = None):
+        self.mode = mode
+        self.num_partitions = num_partitions
+        self.keys = keys
+        self.orders = orders
+        self.children = (child,)
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def describe(self):
+        return f"Repartition({self.mode}, {self.num_partitions})"
+
+
+class Sample(LogicalPlan):
+    def __init__(self, fraction: float, seed: int, child: LogicalPlan):
+        self.fraction = fraction
+        self.seed = seed
+        self.children = (child,)
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+
+class WriteFile(LogicalPlan):
+    """Data-writing command (GpuDataWritingCommandExec analogue)."""
+
+    def __init__(self, fmt: str, path: str, mode: str, options: Dict[str, Any],
+                 child: LogicalPlan):
+        self.fmt = fmt
+        self.path = path
+        self.mode = mode
+        self.options = options
+        self.children = (child,)
+
+    @property
+    def schema(self):
+        return T.Schema([])

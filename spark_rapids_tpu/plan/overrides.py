@@ -1,0 +1,425 @@
+"""TPU overrides: tag every logical operator for TPU support, lower supported
+ones to TPU execs and the rest to CPU execs, insert exchanges and
+host<->device transitions, and produce the explain output.
+
+Reference analogue: GpuOverrides.scala (rule registry + wrap/tag/convert,
+:1884-1902), RapidsMeta.scala (tagging tree, willNotWorkOnGpu reasons :127),
+GpuTransitionOverrides.scala (transition insertion :38-221).  Differences are
+deliberate: the engine owns the frontend, so tagging happens on the *logical*
+plan and the physical planner (exchange insertion, two-phase agg split) runs
+fused with conversion — one pass instead of Catalyst's two.
+
+Per-operator conf gates mirror the reference's generated keys
+(GpuOverrides.scala:129-137): ``spark.rapids.sql.exec.<Name>`` and
+``spark.rapids.sql.expression.<Name>``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.exprs.base import (
+    ColumnRef, Expression, SortOrder, resolve,
+)
+from spark_rapids_tpu.exprs.aggregates import AggregateFunction
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.ops import cpu_exec as C
+from spark_rapids_tpu.ops import tpu_exec as X
+from spark_rapids_tpu.parallel.exchange import (
+    CpuBroadcastExchangeExec, CpuShuffleExchangeExec, TpuShuffleExchangeExec,
+)
+from spark_rapids_tpu.parallel.partitioning import (
+    HashPartitioning, Partitioning, RangePartitioning, RoundRobinPartitioning,
+    SinglePartitioning,
+)
+from spark_rapids_tpu.plan.physical import (
+    DeviceToHostExec, HostToDeviceExec, PhysicalOp,
+)
+
+
+class ExprMeta:
+    """Tags one expression tree (BaseExprMeta analogue,
+    RapidsMeta.scala:656)."""
+
+    def __init__(self, expr: Expression, conf: RapidsConf):
+        self.expr = expr
+        self.conf = conf
+        self.reasons: List[str] = []
+        self._tag(expr)
+
+    def _tag(self, e: Expression):
+        cls = type(e)
+        if cls.tpu_eval is Expression.tpu_eval and \
+                not isinstance(e, AggregateFunction):
+            self.reasons.append(
+                f"expression {e.name} has no TPU implementation")
+        else:
+            reason = e.tpu_supported(self.conf)
+            if reason:
+                self.reasons.append(f"expression {e.name}: {reason}")
+        key = f"spark.rapids.sql.expression.{e.name}"
+        if self.conf.get(key, True) in (False, "false"):
+            self.reasons.append(
+                f"expression {e.name} disabled by {key}")
+        for c in e.children:
+            self._tag(c)
+
+    @property
+    def can_run_on_tpu(self) -> bool:
+        return not self.reasons
+
+
+class PlanMeta:
+    """Tags one logical operator (SparkPlanMeta analogue,
+    RapidsMeta.scala:418)."""
+
+    def __init__(self, node: L.LogicalPlan, conf: RapidsConf):
+        self.node = node
+        self.conf = conf
+        self.reasons: List[str] = []
+        self.children = [PlanMeta(c, conf) for c in node.children]
+
+    def will_not_work(self, reason: str):
+        self.reasons.append(reason)
+
+    def check_exprs(self, *exprs: Expression):
+        for e in exprs:
+            m = ExprMeta(e, self.conf)
+            self.reasons.extend(m.reasons)
+
+    @property
+    def can_run_on_tpu(self) -> bool:
+        return not self.reasons
+
+    def explain_lines(self, depth: int = 0) -> List[str]:
+        ind = "  " * depth
+        name = self.node.name
+        if self.can_run_on_tpu:
+            lines = [f"{ind}*{name} will run on TPU"]
+        else:
+            why = "; ".join(self.reasons)
+            lines = [f"{ind}!{name} cannot run on TPU because {why}"]
+        for c in self.children:
+            lines.extend(c.explain_lines(depth + 1))
+        return lines
+
+
+class TpuOverrides:
+    """The plan rewriter: logical plan -> physical plan with per-operator
+    TPU/CPU placement, exchanges and transitions."""
+
+    def __init__(self, conf: RapidsConf):
+        self.conf = conf
+        self.last_explain: str = ""
+
+    # ------------------------------------------------------------------ tag
+
+    def tag(self, meta: PlanMeta):
+        for c in meta.children:
+            self.tag(c)
+        node = meta.node
+        conf = self.conf
+        if not conf.sql_enabled:
+            meta.will_not_work("spark.rapids.sql.enabled is false")
+            return
+        key = f"spark.rapids.sql.exec.{node.name}"
+        if conf.get(key, True) in (False, "false"):
+            meta.will_not_work(f"disabled by {key}")
+
+        if isinstance(node, (L.InMemoryScan, L.FileScan)):
+            # Scans decode on host by design (SURVEY.md section 7: host Arrow
+            # decode staged into HBM); they are CPU execs + HostToDevice.
+            meta.will_not_work("scans decode host-side (by design)")
+        elif isinstance(node, L.Project):
+            meta.check_exprs(*node.exprs)
+        elif isinstance(node, L.Filter):
+            meta.check_exprs(node.condition)
+        elif isinstance(node, L.Aggregate):
+            meta.check_exprs(*node.keys)
+            for a in node.aggs:
+                meta.check_exprs(a.fn.child)
+                reason = a.fn.tpu_supported(conf)
+                if reason:
+                    meta.will_not_work(f"aggregate {a.fn.name}: {reason}")
+                if any(k.dtype.is_fractional for k in node.keys) and \
+                        conf.has_nans:
+                    meta.will_not_work(
+                        "grouping by floating point when NaNs possible; set "
+                        "spark.rapids.sql.hasNans=false to enable")
+        elif isinstance(node, L.Sort):
+            for o in node.orders:
+                meta.check_exprs(o.child)
+        elif isinstance(node, L.Join):
+            meta.check_exprs(*node.left_keys, *node.right_keys)
+            if node.condition is not None:
+                meta.check_exprs(node.condition)
+                if node.how not in ("inner", "cross"):
+                    meta.will_not_work(
+                        f"{node.how} join with residual condition")
+        elif isinstance(node, L.Expand):
+            for proj in node.projections:
+                meta.check_exprs(*proj)
+        elif isinstance(node, L.Window):
+            for w in node.window_exprs:
+                reason = w.tpu_supported(conf)
+                if reason:
+                    meta.will_not_work(reason)
+        elif isinstance(node, L.Repartition):
+            for k in node.keys:
+                meta.check_exprs(k)
+
+    # -------------------------------------------------------------- convert
+
+    def apply(self, plan: L.LogicalPlan) -> PhysicalOp:
+        meta = PlanMeta(plan, self.conf)
+        self.tag(meta)
+        self.last_explain = "\n".join(meta.explain_lines())
+        if self.conf.explain_enabled:
+            print(self.last_explain)
+        phys = self._convert(meta)
+        return _insert_transitions(phys)
+
+    def _shuffle_parts(self) -> int:
+        return self.conf.shuffle_partitions
+
+    def _convert(self, meta: PlanMeta) -> PhysicalOp:
+        node = meta.node
+        on_tpu = meta.can_run_on_tpu
+        conv = [self._convert(c) for c in meta.children]
+
+        if isinstance(node, L.InMemoryScan):
+            return C.CpuInMemoryScanExec(node.batches, node.schema,
+                                         node.num_partitions)
+        if isinstance(node, L.FileScan):
+            from spark_rapids_tpu.io.scan import CpuFileScanExec
+            return CpuFileScanExec(node, self.conf)
+        if isinstance(node, L.Range):
+            if on_tpu:
+                return X.TpuRangeExec(node.start, node.end, node.step,
+                                      node.num_partitions, node.schema)
+            return C.CpuRangeExec(node.start, node.end, node.step,
+                                  node.num_partitions, node.schema)
+        if isinstance(node, L.Project):
+            if on_tpu:
+                return X.TpuProjectExec(node.exprs, conv[0], node.schema)
+            return C.CpuProjectExec(node.exprs, conv[0], node.schema)
+        if isinstance(node, L.Filter):
+            if on_tpu:
+                return X.TpuFilterExec(node.condition, conv[0])
+            return C.CpuFilterExec(node.condition, conv[0])
+        if isinstance(node, L.Aggregate):
+            return self._convert_aggregate(node, conv[0], on_tpu)
+        if isinstance(node, L.Distinct):
+            child = meta.node.children[0]
+            keys = [ColumnRef(f.name, f.dtype, f.nullable)
+                    for f in child.schema.fields]
+            agg = L.Aggregate(keys, [f.name for f in child.schema.fields],
+                              [], child)
+            return self._convert_aggregate(agg, conv[0], on_tpu)
+        if isinstance(node, L.Sort):
+            return self._convert_sort(node, conv[0], on_tpu)
+        if isinstance(node, L.Join):
+            return self._convert_join(node, conv, on_tpu)
+        if isinstance(node, L.Union):
+            if on_tpu and all(c.is_tpu for c in conv):
+                return X.TpuUnionExec(conv, node.schema)
+            return C.CpuUnionExec(
+                [_to_host(c) for c in conv], node.schema)
+        if isinstance(node, L.Limit):
+            return self._convert_limit(node, conv[0], on_tpu)
+        if isinstance(node, L.Expand):
+            flat_projs = node.projections
+            if on_tpu:
+                return X.TpuExpandExec(flat_projs, conv[0], node.schema)
+            return C.CpuExpandExec(flat_projs, conv[0], node.schema)
+        if isinstance(node, L.Sample):
+            if on_tpu:
+                return X.TpuSampleExec(node.fraction, node.seed, conv[0])
+            return C.CpuSampleExec(node.fraction, node.seed, conv[0])
+        if isinstance(node, L.Repartition):
+            part = self._make_partitioning(node)
+            if on_tpu:
+                return TpuShuffleExchangeExec(part, conv[0])
+            return CpuShuffleExchangeExec(part, conv[0])
+        if isinstance(node, L.Window):
+            from spark_rapids_tpu.ops.window import (
+                CpuWindowExec, TpuWindowExec,
+            )
+            child_schema = meta.node.children[0].schema
+            if on_tpu:
+                return TpuWindowExec(node.window_exprs, node.output_names,
+                                     conv[0], node.schema)
+            return CpuWindowExec(node.window_exprs, node.output_names,
+                                 conv[0], node.schema)
+        raise NotImplementedError(f"cannot convert {node.name}")
+
+    def _make_partitioning(self, node: L.Repartition) -> Partitioning:
+        if node.mode == "hash":
+            return HashPartitioning(node.keys, node.num_partitions)
+        if node.mode == "roundrobin":
+            return RoundRobinPartitioning(node.num_partitions)
+        if node.mode == "single":
+            return SinglePartitioning()
+        if node.mode == "range":
+            child = node.children[0]
+            ordinals = [child.schema.index_of(o.child.column)
+                        for o in node.orders]
+            return RangePartitioning(node.orders, ordinals,
+                                     node.num_partitions)
+        raise ValueError(node.mode)
+
+    def _convert_aggregate(self, node: L.Aggregate, child: PhysicalOp,
+                           on_tpu: bool) -> PhysicalOp:
+        n_parts = self._shuffle_parts()
+        if on_tpu:
+            child = _to_device(child)
+            buf_schema = X._buffer_schema(node.key_names, node.keys,
+                                          node.aggs)
+            partial = X.TpuHashAggregateExec(
+                "update", node.keys, node.key_names, node.aggs, child,
+                buf_schema)
+            if node.keys:
+                keys = [ColumnRef(n, k.dtype, k.nullable)
+                        for n, k in zip(node.key_names, node.keys)]
+                part = HashPartitioning(keys, n_parts)
+            else:
+                part = SinglePartitioning()
+            exchange = TpuShuffleExchangeExec(part, partial)
+            return X.TpuHashAggregateExec(
+                "merge", [ColumnRef(n, k.dtype, k.nullable)
+                          for n, k in zip(node.key_names, node.keys)],
+                node.key_names, node.aggs, exchange, node.schema)
+        # CPU: exchange raw rows by key, then full groupby per partition.
+        child = _to_host(child)
+        if node.keys:
+            part = HashPartitioning(node.keys, n_parts)
+        else:
+            part = SinglePartitioning()
+        exchange = CpuShuffleExchangeExec(part, child)
+        return C.CpuAggregateExec(node.keys, [], node.aggs, exchange,
+                                  node.schema)
+
+    def _convert_sort(self, node: L.Sort, child: PhysicalOp,
+                      on_tpu: bool) -> PhysicalOp:
+        # Sort keys that are not plain column refs get projected into hidden
+        # columns first (Spark does the same materialization for sort exprs).
+        orders = node.orders
+        schema = node.schema
+        hidden = [o for o in orders
+                  if not isinstance(o.child, ColumnRef)]
+        if hidden:
+            base = [ColumnRef(f.name, f.dtype, f.nullable)
+                    for f in schema.fields]
+            names = [f.name for f in schema.fields]
+            extra, new_orders = [], []
+            for i, o in enumerate(orders):
+                if isinstance(o.child, ColumnRef):
+                    new_orders.append(o)
+                else:
+                    nm = f"__sortkey_{i}"
+                    extra.append(o.child)
+                    names.append(nm)
+                    new_orders.append(SortOrder(
+                        ColumnRef(nm, o.child.dtype, o.child.nullable),
+                        o.ascending, o.nulls_first))
+            proj_schema = T.Schema(
+                list(schema.fields) +
+                [T.Field(n, e.dtype, e.nullable)
+                 for n, e in zip(names[len(schema.fields):], extra)])
+            child = (X.TpuProjectExec(base + extra, _to_device(child),
+                                      proj_schema) if on_tpu else
+                     C.CpuProjectExec(base + extra, _to_host(child),
+                                      proj_schema))
+            inner = self._convert_sort(
+                L.Sort(new_orders, node.is_global, _FakeNode(proj_schema)),
+                child, on_tpu)
+            final = [ColumnRef(f.name, f.dtype, f.nullable)
+                     for f in schema.fields]
+            if on_tpu:
+                return X.TpuProjectExec(final, inner, schema)
+            return C.CpuProjectExec(final, inner, schema)
+
+        key_ordinals = [schema.index_of(o.child.column) for o in orders]
+        if node.is_global:
+            part = RangePartitioning(orders, key_ordinals,
+                                     self._shuffle_parts())
+            child = TpuShuffleExchangeExec(part, _to_device(child)) \
+                if on_tpu else CpuShuffleExchangeExec(part, _to_host(child))
+        if on_tpu:
+            return X.TpuSortExec(orders, [o.child for o in orders],
+                                 _to_device(child))
+        return C.CpuSortExec(orders, key_ordinals, _to_host(child))
+
+    def _convert_join(self, node: L.Join, conv: List[PhysicalOp],
+                      on_tpu: bool) -> PhysicalOp:
+        left, right = conv
+        if node.how == "cross" or not node.left_keys:
+            if on_tpu and node.how in ("cross", "inner"):
+                return X.TpuNestedLoopJoinExec(
+                    _to_device(left), _to_device(right), node.condition,
+                    node.schema)
+            return C.CpuNestedLoopJoinExec(
+                _to_host(left), _to_host(right), node.how, node.condition,
+                node.schema)
+        n_parts = self._shuffle_parts()
+        lpart = HashPartitioning(node.left_keys, n_parts)
+        rpart = HashPartitioning(node.right_keys, n_parts)
+        if on_tpu:
+            lex = TpuShuffleExchangeExec(lpart, _to_device(left))
+            rex = TpuShuffleExchangeExec(rpart, _to_device(right))
+            return X.TpuShuffledHashJoinExec(
+                lex, rex, node.left_keys, node.right_keys, node.how,
+                node.condition, node.schema)
+        lex = CpuShuffleExchangeExec(lpart, _to_host(left))
+        rex = CpuShuffleExchangeExec(rpart, _to_host(right))
+        return C.CpuHashJoinExec(lex, rex, node.left_keys, node.right_keys,
+                                 node.how, node.condition, node.schema)
+
+    def _convert_limit(self, node: L.Limit, child: PhysicalOp,
+                       on_tpu: bool) -> PhysicalOp:
+        if on_tpu:
+            local = X.TpuLocalLimitExec(node.n, _to_device(child))
+            single = TpuShuffleExchangeExec(SinglePartitioning(), local)
+            return X.TpuLocalLimitExec(node.n, single)
+        local = C.CpuLocalLimitExec(node.n, _to_host(child))
+        single = CpuShuffleExchangeExec(SinglePartitioning(), local)
+        return C.CpuLocalLimitExec(node.n, single)
+
+
+class _FakeNode:
+    """Minimal logical-node stand-in for recursive planner helpers."""
+
+    def __init__(self, schema: T.Schema):
+        self._schema = schema
+        self.children = ()
+
+    @property
+    def schema(self):
+        return self._schema
+
+
+def _to_device(op: PhysicalOp) -> PhysicalOp:
+    return op if op.is_tpu else HostToDeviceExec(op)
+
+
+def _to_host(op: PhysicalOp) -> PhysicalOp:
+    return DeviceToHostExec(op) if op.is_tpu else op
+
+
+def _insert_transitions(op: PhysicalOp) -> PhysicalOp:
+    """Final pass: make every edge type-correct (device vs host batches) —
+    the GpuTransitionOverrides analogue."""
+    new_children = []
+    for c in op.children:
+        c = _insert_transitions(c)
+        if op.is_tpu and not c.is_tpu and \
+                not isinstance(op, HostToDeviceExec):
+            c = HostToDeviceExec(c)
+        elif not op.is_tpu and c.is_tpu and \
+                not isinstance(op, DeviceToHostExec):
+            c = DeviceToHostExec(c)
+        new_children.append(c)
+    op.children = new_children
+    return op

@@ -1,0 +1,169 @@
+"""Physical operator model.
+
+Execution contract (the ``doExecuteColumnar(): RDD[ColumnarBatch]`` analogue,
+GpuExec.scala:58): every physical op exposes
+``partitions(ctx) -> List[Iterator[batch]]`` — a list of lazily-evaluated
+per-partition batch iterators.  TPU execs yield device
+:class:`~spark_rapids_tpu.batch.ColumnBatch`; CPU (fallback) execs yield host
+:class:`~spark_rapids_tpu.batch.HostBatch`.  The planner inserts
+:class:`HostToDeviceExec` / :class:`DeviceToHostExec` transitions at every
+CPU<->TPU boundary (GpuTransitionOverrides analogue).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Iterator, List, Optional
+
+import jax
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.batch import (
+    ColumnBatch, HostBatch, device_to_host, host_to_device,
+)
+from spark_rapids_tpu.config import RapidsConf
+
+
+class Metric:
+    """A named SQL-metric (GpuMetricNames analogue, GpuExec.scala:27-56)."""
+
+    def __init__(self, name: str, unit: str = ""):
+        self.name = name
+        self.unit = unit
+        self.value = 0
+
+    def add(self, v):
+        self.value += v
+
+    def __repr__(self):
+        return f"{self.name}={self.value}{self.unit}"
+
+
+class ExecContext:
+    """Per-query execution context: conf, metrics, device admission."""
+
+    def __init__(self, conf: RapidsConf, semaphore=None, device=None):
+        self.conf = conf
+        self.semaphore = semaphore
+        self.device = device
+        self.metrics: Dict[str, Dict[str, Metric]] = {}
+
+    def metric(self, op_id: str, name: str) -> Metric:
+        ops = self.metrics.setdefault(op_id, {})
+        if name not in ops:
+            ops[name] = Metric(name)
+        return ops[name]
+
+
+class PhysicalOp:
+    """Base physical operator."""
+
+    is_tpu = False
+
+    def __init__(self, children: List["PhysicalOp"], output_schema: T.Schema):
+        self.children = children
+        self.output_schema = output_schema
+        self.op_id = f"{type(self).__name__}@{id(self):x}"
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def describe(self) -> str:
+        return self.name
+
+    def tree_string(self, depth: int = 0) -> str:
+        out = "  " * depth + ("*" if self.is_tpu else " ") + \
+            self.describe() + "\n"
+        for c in self.children:
+            out += c.tree_string(depth + 1)
+        return out
+
+    def num_partitions(self, ctx: ExecContext) -> int:
+        if self.children:
+            return self.children[0].num_partitions(ctx)
+        return 1
+
+    def partitions(self, ctx: ExecContext) -> List[Iterator]:
+        raise NotImplementedError(self.name)
+
+
+class TpuExec(PhysicalOp):
+    """Operator executing on device over ColumnBatch partitions."""
+
+    is_tpu = True
+
+
+class CpuExec(PhysicalOp):
+    """Host fallback operator over HostBatch partitions."""
+
+    is_tpu = False
+
+
+class HostToDeviceExec(TpuExec):
+    """Stage host batches into HBM (GpuRowToColumnarExec /
+    HostColumnarToGpu analogue: acquire semaphore, bulk-copy to device)."""
+
+    def __init__(self, child: PhysicalOp):
+        super().__init__([child], child.output_schema)
+
+    def describe(self):
+        return "HostToDevice"
+
+    def partitions(self, ctx: ExecContext) -> List[Iterator]:
+        child_parts = self.children[0].partitions(ctx)
+        t_metric = ctx.metric(self.op_id, "stageTime")
+
+        def gen(part):
+            for hb in part:
+                t0 = time.monotonic()
+                if ctx.semaphore is not None:
+                    ctx.semaphore.acquire()
+                yield host_to_device(hb, device=ctx.device)
+                t_metric.add(time.monotonic() - t0)
+
+        return [gen(p) for p in child_parts]
+
+
+class DeviceToHostExec(CpuExec):
+    """Copy device batches back to host (GpuColumnarToRowExec /
+    GpuBringBackToHost analogue)."""
+
+    def __init__(self, child: PhysicalOp):
+        super().__init__([child], child.output_schema)
+
+    def describe(self):
+        return "DeviceToHost"
+
+    def partitions(self, ctx: ExecContext) -> List[Iterator]:
+        child_parts = self.children[0].partitions(ctx)
+
+        def gen(part):
+            for db in part:
+                hb = device_to_host(db)
+                if ctx.semaphore is not None:
+                    ctx.semaphore.release()
+                if hb.num_rows:
+                    yield hb
+
+        return [gen(p) for p in child_parts]
+
+
+def collect_host(op: PhysicalOp, ctx: ExecContext) -> HostBatch:
+    """Drive a plan to completion and concatenate all partitions on host."""
+    root = op if not op.is_tpu else DeviceToHostExec(op)
+    batches: List[HostBatch] = []
+    for part in root.partitions(ctx):
+        batches.extend(part)
+    if not batches:
+        return HostBatch(op.output_schema, [
+            _empty_host_col(f) for f in op.output_schema.fields
+        ])
+    return HostBatch.concat(batches)
+
+
+def _empty_host_col(f: T.Field):
+    import numpy as np
+    from spark_rapids_tpu.batch import HostColumn
+    vals = np.zeros(0, dtype=object if f.dtype.is_string else f.dtype.np_dtype)
+    return HostColumn(f.dtype, vals, np.zeros(0, dtype=np.bool_))

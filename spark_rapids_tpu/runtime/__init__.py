@@ -1,0 +1,3 @@
+"""Device runtime services: device discovery/binding, the TpuSemaphore, and
+the tiered memory catalog (reference: GpuDeviceManager.scala,
+GpuSemaphore.scala, RapidsBufferCatalog.scala — SURVEY.md section 2.4)."""

@@ -1,0 +1,82 @@
+"""Device discovery and admission control.
+
+Reference analogues:
+* GpuDeviceManager.scala:31 — one accelerator per executor process, acquired
+  once and bound for all task threads.  Here: the first JAX device (TPU chip
+  when present, else CPU backend) is selected once per process.
+* GpuSemaphore.scala:58-98 — bounds the number of concurrent tasks admitted
+  to device memory; acquired at every host->device entry point and released
+  when results leave the device.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+
+from spark_rapids_tpu.config import RapidsConf
+
+
+class TpuSemaphore:
+    """Counting semaphore bounding concurrent device-resident tasks.
+
+    Unlike a plain semaphore it is re-entrant per thread (a task thread that
+    already holds it may re-acquire freely), matching
+    GpuSemaphore.acquireIfNecessary semantics (GpuSemaphore.scala:74-87).
+    """
+
+    def __init__(self, permits: int):
+        self._permits = max(1, permits)
+        self._sem = threading.Semaphore(self._permits)
+        self._held = threading.local()
+
+    def acquire(self):
+        depth = getattr(self._held, "depth", 0)
+        if depth == 0:
+            self._sem.acquire()
+        self._held.depth = depth + 1
+
+    def release(self):
+        depth = getattr(self._held, "depth", 0)
+        if depth <= 0:
+            return
+        self._held.depth = depth - 1
+        if self._held.depth == 0:
+            self._sem.release()
+
+    def release_all(self):
+        depth = getattr(self._held, "depth", 0)
+        if depth > 0:
+            self._held.depth = 0
+            self._sem.release()
+
+
+class DeviceRuntime:
+    """Process-wide device services (GpuDeviceManager analogue)."""
+
+    _instance: Optional["DeviceRuntime"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, conf: RapidsConf):
+        self.conf = conf
+        devices = jax.devices()
+        tpus = [d for d in devices if d.platform == "tpu"]
+        self.device = tpus[0] if tpus else devices[0]
+        self.platform = self.device.platform
+        self.semaphore = TpuSemaphore(conf.concurrent_tpu_tasks)
+        from spark_rapids_tpu.mem.catalog import BufferCatalog
+        self.catalog = BufferCatalog(conf)
+
+    @classmethod
+    def get(cls, conf: RapidsConf) -> "DeviceRuntime":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = DeviceRuntime(conf)
+            return cls._instance
+
+    @classmethod
+    def reset(cls):
+        with cls._lock:
+            cls._instance = None

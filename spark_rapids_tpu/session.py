@@ -1,0 +1,202 @@
+"""TpuSparkSession: the user entry point (the analogue of a Spark session
+with the rapids plugin installed — SQLPlugin + RapidsExecutorPlugin,
+Plugin.scala:106-146).
+
+Construction initializes the device runtime once per process: device
+discovery, the TpuSemaphore (device admission), and the spill-tier catalog —
+mirroring RapidsExecutorPlugin.init (Plugin.scala:122-146).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.batch import HostBatch
+from spark_rapids_tpu.config import RapidsConf, conf as global_conf
+
+
+class TpuSparkSession:
+    _lock = threading.Lock()
+    _active: Optional["TpuSparkSession"] = None
+
+    def __init__(self, conf: Optional[RapidsConf] = None,
+                 use_device: bool = True):
+        self.conf = conf or global_conf.copy()
+        from spark_rapids_tpu.runtime.device import DeviceRuntime
+        self.runtime = DeviceRuntime.get(self.conf) if use_device else None
+        with TpuSparkSession._lock:
+            TpuSparkSession._active = self
+
+    # -- builders -----------------------------------------------------------
+
+    @classmethod
+    def builder(cls) -> "SessionBuilder":
+        return SessionBuilder()
+
+    @classmethod
+    def active(cls) -> "TpuSparkSession":
+        with cls._lock:
+            if cls._active is None:
+                cls._active = TpuSparkSession()
+            return cls._active
+
+    # -- conf ---------------------------------------------------------------
+
+    def set_conf(self, key: str, value: Any) -> "TpuSparkSession":
+        self.conf.set(key, value)
+        return self
+
+    # -- data sources -------------------------------------------------------
+
+    def create_dataframe(self, data, schema=None, num_partitions: int = 1):
+        """Build a DataFrame from a pydict {name: (dtype, values)} /
+        {name: values} / list of row tuples + schema."""
+        from spark_rapids_tpu.dataframe import DataFrame
+        from spark_rapids_tpu.plan.logical import InMemoryScan
+        batch = _to_host_batch(data, schema)
+        return DataFrame(InMemoryScan([batch], batch.schema, num_partitions),
+                         self)
+
+    def range(self, start: int, end: Optional[int] = None, step: int = 1,
+              num_partitions: int = 1):
+        from spark_rapids_tpu.dataframe import DataFrame
+        from spark_rapids_tpu.plan.logical import Range
+        if end is None:
+            start, end = 0, start
+        return DataFrame(Range(start, end, step, num_partitions), self)
+
+    @property
+    def read(self) -> "DataFrameReader":
+        return DataFrameReader(self)
+
+    def sql(self, query: str):
+        from spark_rapids_tpu.sql.parser import parse_sql
+        return parse_sql(query, self)
+
+    # -- execution ----------------------------------------------------------
+
+    def execute(self, plan) -> HostBatch:
+        from spark_rapids_tpu.plan.overrides import TpuOverrides
+        from spark_rapids_tpu.plan.physical import ExecContext, collect_host
+        overrides = TpuOverrides(self.conf)
+        phys = overrides.apply(plan)
+        if self.conf.test_enforce_tpu:
+            _assert_on_tpu(phys)
+        ctx = ExecContext(
+            self.conf,
+            semaphore=self.runtime.semaphore if self.runtime else None,
+            device=self.runtime.device if self.runtime else None)
+        self.last_physical_plan = phys
+        self.last_explain = overrides.last_explain
+        return collect_host(phys, ctx)
+
+    def explain_plan(self, plan) -> str:
+        from spark_rapids_tpu.plan.overrides import TpuOverrides
+        overrides = TpuOverrides(self.conf)
+        phys = overrides.apply(plan)
+        return overrides.last_explain + "\n\n" + phys.tree_string()
+
+
+class SessionBuilder:
+    def __init__(self):
+        self._conf = global_conf.copy()
+
+    def config(self, key: str, value: Any) -> "SessionBuilder":
+        self._conf.set(key, value)
+        return self
+
+    def get_or_create(self) -> TpuSparkSession:
+        return TpuSparkSession(self._conf)
+
+
+class DataFrameReader:
+    """session.read.parquet(...) / .csv(...) / .orc(...) entry
+    (GpuReadParquetFileFormat / GpuParquetScan analogues)."""
+
+    def __init__(self, session: TpuSparkSession):
+        self.session = session
+        self._options: Dict[str, Any] = {}
+        self._schema: Optional[T.Schema] = None
+
+    def option(self, key: str, value: Any) -> "DataFrameReader":
+        self._options[key] = value
+        return self
+
+    def schema(self, schema: T.Schema) -> "DataFrameReader":
+        self._schema = schema
+        return self
+
+    def _scan(self, fmt: str, paths: Union[str, Sequence[str]]):
+        from spark_rapids_tpu.dataframe import DataFrame
+        from spark_rapids_tpu.io.discovery import expand_paths, infer_schema
+        from spark_rapids_tpu.plan.logical import FileScan
+        if isinstance(paths, str):
+            paths = [paths]
+        files = expand_paths(list(paths), fmt)
+        schema = self._schema or infer_schema(fmt, files, self._options)
+        return DataFrame(
+            FileScan(fmt, files, schema, dict(self._options)), self.session)
+
+    def parquet(self, *paths: str):
+        return self._scan("parquet", list(paths))
+
+    def csv(self, *paths: str):
+        return self._scan("csv", list(paths))
+
+    def orc(self, *paths: str):
+        return self._scan("orc", list(paths))
+
+
+def _to_host_batch(data, schema) -> HostBatch:
+    import numpy as np
+    if isinstance(data, HostBatch):
+        return data
+    if isinstance(data, dict):
+        first = next(iter(data.values()), None)
+        if isinstance(first, tuple) and len(first) == 2 and \
+                isinstance(first[0], T.DataType):
+            return HostBatch.from_pydict(data)
+        # {name: values}: infer types
+        out = {}
+        for name, values in data.items():
+            dt = _infer_dtype(values)
+            out[name] = (dt, list(values))
+        return HostBatch.from_pydict(out)
+    if isinstance(data, (list, tuple)):
+        assert schema is not None, "list-of-rows input requires a schema"
+        if schema and not isinstance(schema, T.Schema):
+            schema = T.Schema(schema)
+        cols = {f.name: (f.dtype, [row[i] for row in data])
+                for i, f in enumerate(schema.fields)}
+        return HostBatch.from_pydict(cols)
+    raise TypeError(f"cannot build DataFrame from {type(data)}")
+
+
+def _infer_dtype(values) -> T.DataType:
+    for v in values:
+        if v is None:
+            continue
+        if isinstance(v, bool):
+            return T.BOOLEAN
+        if isinstance(v, int):
+            return T.LONG
+        if isinstance(v, float):
+            return T.DOUBLE
+        if isinstance(v, str):
+            return T.STRING
+    return T.STRING
+
+
+def _assert_on_tpu(op, allow=("HostToDeviceExec", "CpuInMemoryScanExec",
+                              "CpuFileScanExec", "DeviceToHostExec",
+                              "CpuShuffleExchangeExec")):
+    """spark.rapids.sql.test.enabled analogue
+    (GpuTransitionOverrides.scala:277-322)."""
+    name = type(op).__name__
+    if not op.is_tpu and name not in allow:
+        raise AssertionError(f"operator {name} fell back to CPU with "
+                             "spark.rapids.sql.test.enabled=true")
+    for c in op.children:
+        _assert_on_tpu(c, allow)

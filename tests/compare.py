@@ -1,0 +1,82 @@
+"""CPU-vs-TPU query compare harness — the SparkQueryCompareTestSuite
+analogue (reference tests/: every test body runs under a CPU session and a
+TPU session and the collected results must match)."""
+
+import pytest
+
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.session import TpuSparkSession
+
+
+def cpu_session(**confs) -> TpuSparkSession:
+    conf = RapidsConf({"spark.rapids.sql.enabled": False,
+                       "spark.sql.shuffle.partitions": 4})
+    for k, v in confs.items():
+        conf.set(k, v)
+    return TpuSparkSession(conf)
+
+
+def tpu_session(**confs) -> TpuSparkSession:
+    conf = RapidsConf({"spark.rapids.sql.enabled": True,
+                       "spark.sql.shuffle.partitions": 4})
+    for k, v in confs.items():
+        conf.set(k, v)
+    return TpuSparkSession(conf)
+
+
+def _canon(rows, approx, ignore_order):
+    def enc(v):
+        if v is None:
+            return (0, "")
+        if isinstance(v, float):
+            if v != v:
+                return (1, "NaN")
+            if approx:
+                return (1, round(v, 6))
+            return (1, v)
+        if isinstance(v, bool):
+            return (2, v)
+        return (3, str(v)) if not isinstance(v, (int, float)) else (1, v)
+
+    out = [tuple(enc(v) for v in r) for r in rows]
+    if ignore_order:
+        out = sorted(out, key=lambda r: str(r))
+    return out
+
+
+def assert_tpu_cpu_equal(build_fn, approx=False, ignore_order=True,
+                         confs=None, expect_fallback=None):
+    """build_fn(session) -> DataFrame; runs on both engines and compares.
+
+    expect_fallback: optional operator-name substring expected in the explain
+    output's cannot-run list (assert_gpu_fallback_collect analogue).
+    """
+    confs = confs or {}
+    cpu = cpu_session(**confs)
+    tpu = tpu_session(**confs)
+    cpu_rows = build_fn(cpu).collect()
+    df = build_fn(tpu)
+    tpu_rows = df.collect()
+    if expect_fallback:
+        explain = tpu.last_explain
+        assert expect_fallback in explain and "cannot run on TPU" in explain, \
+            f"expected fallback of {expect_fallback}; explain:\n{explain}"
+    a = _canon(cpu_rows, approx, ignore_order)
+    b = _canon(tpu_rows, approx, ignore_order)
+    assert len(a) == len(b), \
+        f"row count: cpu={len(a)} tpu={len(b)}\ncpu={a[:10]}\ntpu={b[:10]}"
+    for i, (ra, rb) in enumerate(zip(a, b)):
+        if approx:
+            _row_approx_eq(ra, rb, i)
+        else:
+            assert ra == rb, f"row {i}: cpu={ra} tpu={rb}"
+
+
+def _row_approx_eq(ra, rb, i):
+    assert len(ra) == len(rb), f"row {i} width"
+    for (ta, va), (tb, vb) in zip(ra, rb):
+        assert ta == tb, f"row {i}: {va!r} vs {vb!r}"
+        if isinstance(va, float) and isinstance(vb, float):
+            assert vb == pytest.approx(va, rel=1e-5, abs=1e-8), f"row {i}"
+        else:
+            assert va == vb, f"row {i}: {va!r} vs {vb!r}"
