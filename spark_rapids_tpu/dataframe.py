@@ -219,7 +219,38 @@ class DataFrame:
             e = self._resolve(_to_expr(c))
             exprs.append(e)
             names.append(output_name(e, i))
-        return DataFrame(L.Project(exprs, names, self.plan), self.session)
+        return DataFrame(self._project_node(exprs, names), self.session)
+
+    def _project_node(self, exprs: List[Expression], names: List[str]):
+        """Build a Project, extracting top-level window expressions into
+        Window nodes below it (Spark's ExtractWindowExpressions analogue)."""
+        from spark_rapids_tpu.exprs.windows import WindowExpression
+
+        def core_of(e):
+            return e.children[0] if isinstance(e, Alias) else e
+
+        win = [(i, core_of(e)) for i, e in enumerate(exprs)
+               if isinstance(core_of(e), WindowExpression)]
+        if not win:
+            return L.Project(exprs, names, self.plan)
+        # group by (partition, order) spec; one Window node per group
+        groups: Dict[str, List[Tuple[int, Any]]] = {}
+        for i, w in win:
+            key = f"{[repr(p) for p in w.partition_by]}|" \
+                  f"{[(repr(o.child), o.ascending, o.nulls_first) for o in w.order_by]}"
+            groups.setdefault(key, []).append((i, w))
+        child = self.plan
+        new_exprs = list(exprs)
+        for gi, (key, items) in enumerate(groups.items()):
+            wexprs, wnames = [], []
+            for i, w in items:
+                hidden = f"__w{i}"
+                wexprs.append(w)
+                wnames.append(hidden)
+                new_exprs[i] = ColumnRef(hidden, w.dtype, True)
+            child = L.Window(wexprs, wnames, child)
+        resolved = [resolve(e, child.schema) for e in new_exprs]
+        return L.Project(resolved, names, child)
 
     def with_column(self, name: str, col) -> "DataFrame":
         exprs, names = [], []
@@ -234,7 +265,7 @@ class DataFrame:
         if not replaced:
             exprs.append(self._resolve(_to_expr(col)))
             names.append(name)
-        return DataFrame(L.Project(exprs, names, self.plan), self.session)
+        return DataFrame(self._project_node(exprs, names), self.session)
 
     withColumn = with_column
 

@@ -1,0 +1,318 @@
+"""Column function library (the pyspark.sql.functions analogue over the
+expression library of SURVEY.md section 2.5)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Union
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.dataframe import Column, _to_expr
+from spark_rapids_tpu.exprs import aggregates as A
+from spark_rapids_tpu.exprs import windows as W
+from spark_rapids_tpu.exprs.base import (
+    Alias, ColumnRef, Expression, Literal, SortOrder,
+)
+
+
+def col(name: str) -> Column:
+    return Column(ColumnRef(name))
+
+
+def lit(value: Any) -> Column:
+    return Column(Literal(value))
+
+
+# -- aggregates --------------------------------------------------------------
+
+
+def _agg(cls, c) -> Column:
+    return Column(cls(_to_expr(col(c) if isinstance(c, str) else c)))
+
+
+def sum(c) -> Column:  # noqa: A001
+    return _agg(A.Sum, c)
+
+
+def count(c) -> Column:
+    if isinstance(c, str) and c == "*":
+        return Column(A.count_star())
+    return _agg(A.Count, c)
+
+
+def avg(c) -> Column:
+    return _agg(A.Average, c)
+
+
+mean = avg
+
+
+def min(c) -> Column:  # noqa: A001
+    return _agg(A.Min, c)
+
+
+def max(c) -> Column:  # noqa: A001
+    return _agg(A.Max, c)
+
+
+def first(c, ignore_nulls: bool = False) -> Column:
+    e = _to_expr(col(c) if isinstance(c, str) else c)
+    return Column(A.First(e, ignore_nulls))
+
+
+def last(c, ignore_nulls: bool = False) -> Column:
+    e = _to_expr(col(c) if isinstance(c, str) else c)
+    return Column(A.Last(e, ignore_nulls))
+
+
+def count_distinct(c) -> Column:
+    raise NotImplementedError(
+        "countDistinct lowers to distinct+count; use "
+        "df.select(c).distinct().count()")
+
+
+# -- scalar functions --------------------------------------------------------
+
+
+def _unary(cls, c) -> Column:
+    return Column(cls(_to_expr(col(c) if isinstance(c, str) else c)))
+
+
+def abs(c) -> Column:  # noqa: A001
+    from spark_rapids_tpu.exprs.arithmetic import Abs
+    return _unary(Abs, c)
+
+
+def sqrt(c) -> Column:
+    from spark_rapids_tpu.exprs.mathexprs import Sqrt
+    return _unary(Sqrt, c)
+
+
+def exp(c) -> Column:
+    from spark_rapids_tpu.exprs.mathexprs import Exp
+    return _unary(Exp, c)
+
+
+def log(c) -> Column:
+    from spark_rapids_tpu.exprs.mathexprs import Log
+    return _unary(Log, c)
+
+
+def floor(c) -> Column:
+    from spark_rapids_tpu.exprs.mathexprs import Floor
+    return _unary(Floor, c)
+
+
+def ceil(c) -> Column:
+    from spark_rapids_tpu.exprs.mathexprs import Ceil
+    return _unary(Ceil, c)
+
+
+def round(c, scale: int = 0) -> Column:  # noqa: A001
+    from spark_rapids_tpu.exprs.mathexprs import Round
+    e = _to_expr(col(c) if isinstance(c, str) else c)
+    return Column(Round(e, scale))
+
+
+def pow(b, e) -> Column:  # noqa: A001
+    from spark_rapids_tpu.exprs.mathexprs import Pow
+    return Column(Pow(_to_expr(b), _to_expr(e)))
+
+
+def coalesce(*cols) -> Column:
+    from spark_rapids_tpu.exprs.nullexprs import Coalesce
+    return Column(Coalesce(*[_to_expr(c) for c in cols]))
+
+
+def isnull(c) -> Column:
+    from spark_rapids_tpu.exprs.nullexprs import IsNull
+    return _unary(IsNull, c)
+
+
+def isnan(c) -> Column:
+    from spark_rapids_tpu.exprs.nullexprs import IsNan
+    return _unary(IsNan, c)
+
+
+def when(condition, value) -> "CaseBuilder":
+    return CaseBuilder().when(condition, value)
+
+
+class CaseBuilder:
+    def __init__(self):
+        self._branches = []
+
+    def when(self, condition, value) -> "CaseBuilder":
+        self._branches.append((_to_expr(condition), _to_expr(value)))
+        return self
+
+    def otherwise(self, value) -> Column:
+        from spark_rapids_tpu.exprs.conditional import CaseWhen
+        return Column(CaseWhen(self._branches, _to_expr(value)))
+
+    @property
+    def column(self) -> Column:
+        from spark_rapids_tpu.exprs.conditional import CaseWhen
+        return Column(CaseWhen(self._branches, None))
+
+    # allow using a CaseBuilder directly as a Column (no otherwise = NULL)
+    @property
+    def expr(self):
+        return self.column.expr
+
+
+def upper(c) -> Column:
+    from spark_rapids_tpu.exprs.strings import Upper
+    return _unary(Upper, c)
+
+
+def lower(c) -> Column:
+    from spark_rapids_tpu.exprs.strings import Lower
+    return _unary(Lower, c)
+
+
+def length(c) -> Column:
+    from spark_rapids_tpu.exprs.strings import Length
+    return _unary(Length, c)
+
+
+def trim(c) -> Column:
+    from spark_rapids_tpu.exprs.strings import StringTrim
+    return _unary(StringTrim, c)
+
+
+def concat(*cols) -> Column:
+    from spark_rapids_tpu.exprs.strings import ConcatStrings
+    return Column(ConcatStrings(*[_to_expr(
+        col(c) if isinstance(c, str) else c) for c in cols]))
+
+
+def substring(c, pos: int, length: int) -> Column:
+    from spark_rapids_tpu.exprs.strings import Substring
+    e = _to_expr(col(c) if isinstance(c, str) else c)
+    return Column(Substring(e, pos, length))
+
+
+def year(c) -> Column:
+    from spark_rapids_tpu.exprs.datetime import Year
+    return _unary(Year, c)
+
+
+def month(c) -> Column:
+    from spark_rapids_tpu.exprs.datetime import Month
+    return _unary(Month, c)
+
+
+def dayofmonth(c) -> Column:
+    from spark_rapids_tpu.exprs.datetime import DayOfMonth
+    return _unary(DayOfMonth, c)
+
+
+def hash(*cols) -> Column:  # noqa: A001
+    from spark_rapids_tpu.exprs.hashing import Murmur3Hash
+    return Column(Murmur3Hash(*[_to_expr(
+        col(c) if isinstance(c, str) else c) for c in cols]))
+
+
+def monotonically_increasing_id() -> Column:
+    from spark_rapids_tpu.exprs.misc import MonotonicallyIncreasingID
+    return Column(MonotonicallyIncreasingID())
+
+
+def spark_partition_id() -> Column:
+    from spark_rapids_tpu.exprs.misc import SparkPartitionID
+    return Column(SparkPartitionID())
+
+
+def rand(seed: int = 42) -> Column:
+    from spark_rapids_tpu.exprs.misc import Rand
+    return Column(Rand(seed))
+
+
+# -- window ------------------------------------------------------------------
+
+
+class WindowSpec:
+    def __init__(self, partition_by=None, order_by=None, frame=None):
+        self._partition_by = partition_by or []
+        self._order_by = order_by or []
+        self._frame = frame
+
+    def partition_by(self, *cols) -> "WindowSpec":
+        exprs = [_to_expr(col(c) if isinstance(c, str) else c) for c in cols]
+        return WindowSpec(exprs, self._order_by, self._frame)
+
+    partitionBy = partition_by
+
+    def order_by(self, *cols) -> "WindowSpec":
+        from spark_rapids_tpu.dataframe import _to_order
+        orders = [_to_order(c) for c in cols]
+        return WindowSpec(self._partition_by, orders, self._frame)
+
+    orderBy = order_by
+
+    def rows_between(self, start, end) -> "WindowSpec":
+        s = None if start in (Window.unboundedPreceding, None) else int(start)
+        e = None if end in (Window.unboundedFollowing, None) else int(end)
+        return WindowSpec(self._partition_by, self._order_by,
+                          W.WindowFrame("rows", s, e))
+
+    rowsBetween = rows_between
+
+
+class Window:
+    unboundedPreceding = object()
+    unboundedFollowing = object()
+    currentRow = 0
+
+    @staticmethod
+    def partition_by(*cols) -> WindowSpec:
+        return WindowSpec().partition_by(*cols)
+
+    partitionBy = partition_by
+
+    @staticmethod
+    def order_by(*cols) -> WindowSpec:
+        return WindowSpec().order_by(*cols)
+
+    orderBy = order_by
+
+
+class _OverColumn(Column):
+    pass
+
+
+def _over(self: Column, spec: WindowSpec) -> Column:
+    e = self.expr
+    name = None
+    if isinstance(e, Alias):
+        name, e = e.alias_name, e.children[0]
+    w = W.WindowExpression(e, spec._partition_by, spec._order_by,
+                           spec._frame)
+    return Column(Alias(w, name) if name else w)
+
+
+Column.over = _over  # type: ignore[attr-defined]
+
+
+def row_number() -> Column:
+    return Column(W.RowNumber())
+
+
+def rank() -> Column:
+    return Column(W.Rank())
+
+
+def dense_rank() -> Column:
+    return Column(W.DenseRank())
+
+
+def lag(c, offset: int = 1, default=None) -> Column:
+    e = _to_expr(col(c) if isinstance(c, str) else c)
+    d = None if default is None else _to_expr(default)
+    return Column(W.Lag(e, offset, d))
+
+
+def lead(c, offset: int = 1, default=None) -> Column:
+    e = _to_expr(col(c) if isinstance(c, str) else c)
+    d = None if default is None else _to_expr(default)
+    return Column(W.Lead(e, offset, d))

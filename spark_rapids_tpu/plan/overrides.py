@@ -247,12 +247,17 @@ class TpuOverrides:
             from spark_rapids_tpu.ops.window import (
                 CpuWindowExec, TpuWindowExec,
             )
-            child_schema = meta.node.children[0].schema
+            w0 = node.window_exprs[0]
+            part = HashPartitioning(w0.partition_by,
+                                    self._shuffle_parts()) \
+                if w0.partition_by else SinglePartitioning()
             if on_tpu:
+                ex = TpuShuffleExchangeExec(part, _to_device(conv[0]))
                 return TpuWindowExec(node.window_exprs, node.output_names,
-                                     conv[0], node.schema)
+                                     ex, node.schema)
+            ex = CpuShuffleExchangeExec(part, _to_host(conv[0]))
             return CpuWindowExec(node.window_exprs, node.output_names,
-                                 conv[0], node.schema)
+                                 ex, node.schema)
         raise NotImplementedError(f"cannot convert {node.name}")
 
     def _make_partitioning(self, node: L.Repartition) -> Partitioning:

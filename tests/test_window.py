@@ -1,0 +1,80 @@
+"""Window function tests (WindowFunctionSuite analogue): TPU vs CPU."""
+
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu.functions import Window
+
+from compare import assert_tpu_cpu_equal
+
+DATA = {
+    "g": (T.STRING, ["a", "a", "a", "b", "b", None, "c", "c", "c", "c"]),
+    "x": (T.INT, [3, 1, 2, 5, 5, 7, None, 2, 9, 2]),
+    "v": (T.LONG, [10, 20, 30, 40, None, 60, 70, 80, 90, 100]),
+}
+
+
+def make_df(s):
+    return s.create_dataframe(DATA, num_partitions=3)
+
+
+def test_row_number():
+    def q(s):
+        w = Window.partition_by("g").order_by("x", "v")
+        return make_df(s).with_column("rn", F.row_number().over(w))
+    assert_tpu_cpu_equal(q)
+
+
+def test_rank_dense_rank():
+    def q(s):
+        w = Window.partition_by("g").order_by("x")
+        df = make_df(s)
+        return df.with_column("rk", F.rank().over(w)) \
+                 .with_column("drk", F.dense_rank().over(w))
+    assert_tpu_cpu_equal(q)
+
+
+def test_running_sum_and_count():
+    def q(s):
+        w = Window.partition_by("g").order_by("x")
+        df = make_df(s)
+        return df.with_column("rs", F.sum("v").over(w)) \
+                 .with_column("rc", F.count("v").over(w))
+    assert_tpu_cpu_equal(q)
+
+
+def test_whole_partition_agg():
+    def q(s):
+        w = Window.partition_by("g")
+        df = make_df(s)
+        return df.with_column("tot", F.sum("v").over(w)) \
+                 .with_column("mx", F.max("v").over(w))
+    assert_tpu_cpu_equal(q)
+
+
+def test_bounded_rows_frame():
+    def q(s):
+        w = Window.partition_by("g").order_by("x", "v") \
+            .rows_between(-1, 1)
+        df = make_df(s)
+        return df.with_column("s3", F.sum("v").over(w)) \
+                 .with_column("m3", F.min("v").over(w)) \
+                 .with_column("a3", F.avg("v").over(w))
+    assert_tpu_cpu_equal(q, approx=True)
+
+
+def test_lag_lead():
+    def q(s):
+        w = Window.partition_by("g").order_by("x", "v")
+        df = make_df(s)
+        return df.with_column("lg", F.lag("v", 1).over(w)) \
+                 .with_column("ld", F.lead("v", 2).over(w))
+    assert_tpu_cpu_equal(q)
+
+
+def test_window_no_partition():
+    def q(s):
+        w = Window.order_by("x", "v")
+        return make_df(s).with_column("rn", F.row_number().over(w))
+    assert_tpu_cpu_equal(q)
