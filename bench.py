@@ -1,0 +1,81 @@
+"""Benchmark driver: TPC-DS q6-style pipeline (scan -> filter -> project ->
+hash aggregate -> sort) through the full engine, TPU plan vs CPU fallback
+plan (the Spark-CPU stand-in).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+value = TPU rows/sec through the pipeline; vs_baseline = TPU throughput /
+CPU-engine throughput (the reference's own headline is 3-7x vs Spark CPU,
+docs/FAQ.md:60-66 — BASELINE.md).
+"""
+
+import json
+import time
+
+import numpy as np
+
+ROWS = 1 << 21  # 2M rows
+PARTS = 8
+
+
+def make_data(rows: int):
+    from spark_rapids_tpu import types as T
+    rng = np.random.RandomState(42)
+    return {
+        "ss_item_sk": (T.INT, rng.randint(0, 2000, rows)),
+        "ss_quantity": (T.INT, rng.randint(1, 101, rows)),
+        "ss_sales_price": (T.DOUBLE, (rng.rand(rows) * 200).round(2)),
+        "ss_ext_discount_amt": (T.DOUBLE, (rng.rand(rows) * 100).round(2)),
+    }
+
+
+def build_query(session, data):
+    from spark_rapids_tpu import functions as F
+    df = session.create_dataframe(data, num_partitions=PARTS)
+    return (df
+            .filter((df["ss_quantity"] < 25) &
+                    (df["ss_ext_discount_amt"] > 10.0))
+            .with_column("revenue",
+                         df["ss_sales_price"] * df["ss_ext_discount_amt"])
+            .group_by("ss_item_sk")
+            .agg(F.sum("revenue").alias("sum_rev"),
+                 F.count("revenue").alias("cnt"),
+                 F.avg("ss_sales_price").alias("avg_price"))
+            .order_by("ss_item_sk"))
+
+
+def time_engine(tpu_enabled: bool, data, runs: int = 3) -> float:
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.session import TpuSparkSession
+    conf = RapidsConf({
+        "spark.rapids.sql.enabled": tpu_enabled,
+        "spark.sql.shuffle.partitions": PARTS,
+    })
+    s = TpuSparkSession(conf)
+    q = build_query(s, data)
+    q.collect()  # warmup (compile)
+    best = float("inf")
+    for _ in range(runs):
+        t0 = time.monotonic()
+        rows = q.collect()
+        dt = time.monotonic() - t0
+        best = min(best, dt)
+    assert rows, "empty result"
+    return best
+
+
+def main():
+    data = make_data(ROWS)
+    tpu_t = time_engine(True, data)
+    cpu_t = time_engine(False, data)
+    value = ROWS / tpu_t
+    vs = cpu_t / tpu_t
+    print(json.dumps({
+        "metric": "q6_like_rows_per_sec",
+        "value": round(value, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

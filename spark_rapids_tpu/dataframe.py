@@ -486,6 +486,11 @@ class DataFrame:
                                  for x, w in zip(r, widths)) + "|")
         print(line)
 
+    def create_or_replace_temp_view(self, name: str):
+        self.session.register_view(name, self)
+
+    createOrReplaceTempView = create_or_replace_temp_view
+
     def explain(self) -> str:
         s = self.session.explain_plan(self.plan)
         print(s)

@@ -1,0 +1,121 @@
+"""Distributed query step over a device mesh: the flagship SPMD pipeline
+(partition -> ICI all-to-all -> local merge aggregation), demonstrating the
+full multi-chip shuffle path that replaces the reference's
+RapidsShuffleManager+UCX data plane (SURVEY.md section 2.7).
+
+The same step structure the driver dry-runs: every device holds one shard of
+rows, hashes its grouping keys, exchanges rows so equal keys co-locate, and
+merge-aggregates locally — i.e. the Partial/Exchange/Final pipeline of
+TpuHashAggregateExec, fused into one compiled SPMD program.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spark_rapids_tpu.parallel.mesh_shuffle import (
+    DATA_AXIS, make_exchange_fn, make_mesh,
+)
+
+
+def _local_sum_by_key(keys, values, validity, num_rows, cap: int):
+    """Per-device groupby-sum on int64 keys via sort + segment sums."""
+    live = jnp.arange(cap, dtype=jnp.int32) < num_rows
+    big = jnp.int64(jnp.iinfo(jnp.int64).max)
+    k = jnp.where(live, keys, big)
+    order = jnp.argsort(k, stable=True).astype(jnp.int32)
+    ks = k[order]
+    vs = jnp.where(validity[order] & live[order], values[order], 0)
+    prev = jnp.concatenate([ks[:1] - 1, ks[:-1]])
+    seg_start = live[order] & (ks != prev)
+    seg_ids = jnp.clip(jnp.cumsum(seg_start.astype(jnp.int32)) - 1, 0,
+                       cap - 1)
+    sums = jax.ops.segment_sum(vs, seg_ids, num_segments=cap)
+    n_groups = jnp.sum(seg_start).astype(jnp.int32)
+    group_keys = jnp.where(seg_start, ks, big)
+    gorder = jnp.argsort(jnp.where(seg_start, 0, 1), stable=True)
+    out_keys = ks[gorder]
+    return out_keys, sums, n_groups
+
+
+def make_distributed_agg_step(mesh: Mesh, cap: int):
+    """jitted SPMD fn: (keys [N,cap] i64, values [N,cap] i64,
+    validity [N,cap] bool, num_rows [N]) ->
+    (group_keys [N, N*cap], sums [N, N*cap], n_groups [N])."""
+    n = mesh.shape[DATA_AXIS]
+    exchange = make_exchange_fn(mesh, n_cols=2, cap=cap)
+
+    from jax import shard_map
+
+    def local_agg(keys, values, validity, num_rows):
+        k, v, val, nr = keys[0], values[0], validity[0], num_rows[0]
+        out_cap = int(k.shape[0])
+        gk, gs, ng = _local_sum_by_key(k, v, val, nr, out_cap)
+        return gk[None], gs[None], ng[None]
+
+    local_agg_fn = jax.jit(shard_map(
+        local_agg, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None),
+                  P(DATA_AXIS, None), P(DATA_AXIS)),
+        out_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None), P(DATA_AXIS))))
+
+    def step(keys, values, validity, num_rows):
+        pids = (jnp.abs(keys) % n).astype(jnp.int32)
+        (d_cols, v_cols, new_rows) = exchange(
+            [keys, values], [validity, validity], num_rows, pids)
+        ex_keys, ex_vals = d_cols
+        ex_kvalid, ex_vvalid = v_cols
+        return local_agg_fn(ex_keys, ex_vals, ex_vvalid, new_rows)
+
+    return jax.jit(step)
+
+
+def run_distributed_agg_demo(n_devices: int, rows_per_device: int = 256,
+                             n_keys: int = 17) -> dict:
+    """Create an n-device mesh, run one full distributed aggregation step,
+    verify against numpy, and return stats.  This is what
+    ``__graft_entry__.dryrun_multichip`` calls."""
+    mesh = make_mesh(n_devices)
+    n = mesh.shape[DATA_AXIS]
+    cap = rows_per_device
+    rng = np.random.RandomState(7)
+    keys = rng.randint(0, n_keys, size=(n, cap)).astype(np.int64)
+    values = rng.randint(-100, 100, size=(n, cap)).astype(np.int64)
+    validity = rng.rand(n, cap) < 0.9
+    num_rows = np.full(n, cap, dtype=np.int32)
+    num_rows[-1] = cap // 2  # ragged shard
+
+    sharding = NamedSharding(mesh, P(DATA_AXIS, None))
+    s1 = NamedSharding(mesh, P(DATA_AXIS))
+    dk = jax.device_put(keys, sharding)
+    dv = jax.device_put(values, sharding)
+    dva = jax.device_put(validity, sharding)
+    dn = jax.device_put(num_rows, s1)
+
+    step = make_distributed_agg_step(mesh, cap)
+    gk, gs, ng = jax.block_until_ready(step(dk, dv, dva, dn))
+
+    # oracle
+    expect = {}
+    for d in range(n):
+        for r in range(num_rows[d]):
+            if validity[d, r]:
+                expect[int(keys[d, r])] = expect.get(int(keys[d, r]), 0) + \
+                    int(values[d, r])
+            else:
+                expect.setdefault(int(keys[d, r]), 0)
+    got = {}
+    gk_h = np.asarray(gk)
+    gs_h = np.asarray(gs)
+    ng_h = np.asarray(ng)
+    for d in range(n):
+        for i in range(int(ng_h[d])):
+            got[int(gk_h[d, i])] = got.get(int(gk_h[d, i]), 0) + \
+                int(gs_h[d, i])
+    assert got == expect, f"distributed agg mismatch: {got} != {expect}"
+    return {"devices": n, "groups": len(got), "rows": int(num_rows.sum())}

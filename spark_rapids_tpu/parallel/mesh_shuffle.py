@@ -1,0 +1,136 @@
+"""Device-mesh shuffle: XLA all-to-all over ICI (the accelerated-shuffle
+analogue of the reference's UCX transport, SURVEY.md section 2.7b).
+
+Where the reference moves map-side device batches between executors with UCX
+tag-matched sends (UCX.scala:247-311), the TPU build keeps each partition's
+batch sharded over a ``jax.sharding.Mesh`` and exchanges rows with a single
+``lax.all_to_all`` collective inside ``shard_map`` — the transfer rides ICI
+and is scheduled by XLA, no progress thread / bounce buffers needed.
+
+Layout contract: a *mesh batch* is a pytree of arrays whose leading axis is
+the mesh's ``data`` axis (one slice per device): data[N, cap], validity
+[N, cap], num_rows[N].  Strings are not yet supported on this path (they
+fall back to the host exchange) — the bucket padding story for varlen
+buffers lands with the native transport work.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.batch import ColumnBatch, DeviceColumn
+
+DATA_AXIS = "data"
+
+
+def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    return Mesh(np.array(devs[:n]), (DATA_AXIS,))
+
+
+def _local_partition_buckets(data_cols, validity_cols, num_rows, pids,
+                             n: int, cap: int):
+    """Split local rows into n destination buckets of fixed capacity cap.
+
+    Returns (bucketed columns [n, cap], bucketed validity [n, cap],
+    counts [n]).  Gather-formulated: bucket d row j = j-th local row with
+    pid == d.
+    """
+    live = jnp.arange(cap, dtype=jnp.int32) < num_rows
+    pids = jnp.where(live, pids, n)  # padding rows to a dead bucket
+    # stable order rows by pid -> rows of bucket d are contiguous
+    order = jnp.argsort(pids, stable=True).astype(jnp.int32)
+    sorted_pids = pids[order]
+    counts = jnp.zeros(n + 1, dtype=jnp.int32).at[sorted_pids].add(
+        1, mode="drop")[:n]
+    starts = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                              jnp.cumsum(counts).astype(jnp.int32)[:-1]])
+    # bucket[d, j] = sorted row at starts[d] + j (valid when j < counts[d])
+    d_idx = jnp.arange(n, dtype=jnp.int32)[:, None]
+    j_idx = jnp.arange(cap, dtype=jnp.int32)[None, :]
+    src = jnp.clip(starts[:, None] + j_idx, 0, cap - 1)
+    in_bucket = j_idx < counts[:, None]
+    rows = order[src]
+    out_data = [jnp.where(in_bucket, c[rows], 0) for c in data_cols]
+    out_valid = [jnp.where(in_bucket, v[rows], False)
+                 for v in validity_cols]
+    return out_data, out_valid, counts
+
+
+def _compact_received(data_cols, validity_cols, counts, n: int, cap: int):
+    """Concatenate n received buckets ([n, cap] each) into one local batch
+    of capacity n*cap."""
+    total = jnp.sum(counts)
+    out_cap = n * cap
+    flat_pos = jnp.arange(out_cap, dtype=jnp.int32)
+    cum = jnp.cumsum(counts)
+    starts = cum - counts
+    bucket = jnp.searchsorted(cum, flat_pos, side="right").astype(jnp.int32)
+    bucket_c = jnp.clip(bucket, 0, n - 1)
+    within = flat_pos - starts[bucket_c]
+    live = flat_pos < total
+    within = jnp.clip(within, 0, cap - 1)
+    out_data = [jnp.where(live, c[bucket_c, within], 0) for c in data_cols]
+    out_valid = [jnp.where(live, v[bucket_c, within], False)
+                 for v in validity_cols]
+    return out_data, out_valid, total.astype(jnp.int32)
+
+
+def all_to_all_exchange(mesh: Mesh, data_cols, validity_cols, num_rows,
+                        pids):
+    """SPMD row exchange: every row moves to the device ``pids`` names.
+
+    Inputs are mesh-sharded: data_cols/validity_cols [N*cap] sharded on the
+    leading axis? No — this function is built to be called INSIDE shard_map
+    with per-device locals; see :func:`make_exchange_fn` for the wrapper.
+    """
+    raise NotImplementedError("use make_exchange_fn")
+
+
+def make_exchange_fn(mesh: Mesh, n_cols: int, cap: int):
+    """Build a jittable SPMD function exchanging rows by partition id.
+
+    fn(data_cols [N,cap]xk, validity_cols [N,cap]xk, num_rows [N],
+       pids [N,cap]) -> (data [N, N*cap]xk, validity ..., num_rows [N])
+    """
+    n = mesh.shape[DATA_AXIS]
+
+    def spmd(data_cols, validity_cols, num_rows, pids):
+        # inside shard_map: leading axis is local (size 1); drop it
+        data_cols = [c[0] for c in data_cols]
+        validity_cols = [v[0] for v in validity_cols]
+        nr = num_rows[0]
+        p = pids[0]
+        b_data, b_valid, counts = _local_partition_buckets(
+            data_cols, validity_cols, nr, p, n, cap)
+        # exchange bucket d -> device d; receive one bucket per device
+        r_data = [jax.lax.all_to_all(c, DATA_AXIS, 0, 0, tiled=False)
+                  for c in b_data]
+        r_valid = [jax.lax.all_to_all(v, DATA_AXIS, 0, 0, tiled=False)
+                   for v in b_valid]
+        r_counts = jax.lax.all_to_all(counts, DATA_AXIS, 0, 0, tiled=False)
+        o_data, o_valid, o_rows = _compact_received(
+            r_data, r_valid, r_counts, n, cap)
+        return ([c[None] for c in o_data], [v[None] for v in o_valid],
+                o_rows[None])
+
+    from jax import shard_map
+    in_specs = (
+        [P(DATA_AXIS, None)] * n_cols,
+        [P(DATA_AXIS, None)] * n_cols,
+        P(DATA_AXIS),
+        P(DATA_AXIS, None),
+    )
+    out_specs = ([P(DATA_AXIS, None)] * n_cols,
+                 [P(DATA_AXIS, None)] * n_cols,
+                 P(DATA_AXIS))
+    return jax.jit(shard_map(spmd, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs))

@@ -26,8 +26,20 @@ class TpuSparkSession:
         self.conf = conf or global_conf.copy()
         from spark_rapids_tpu.runtime.device import DeviceRuntime
         self.runtime = DeviceRuntime.get(self.conf) if use_device else None
+        self._views: Dict[str, Any] = {}
         with TpuSparkSession._lock:
             TpuSparkSession._active = self
+
+    # -- catalog ------------------------------------------------------------
+
+    def register_view(self, name: str, df) -> None:
+        self._views[name.lower()] = df
+
+    def table(self, name: str):
+        df = self._views.get(name.lower())
+        if df is None:
+            raise KeyError(f"table or view not found: {name}")
+        return df
 
     # -- builders -----------------------------------------------------------
 

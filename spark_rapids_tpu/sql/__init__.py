@@ -1,0 +1,3 @@
+"""SQL frontend: lexer + recursive-descent parser producing logical plans
+(Catalyst's parser role; the reference relies on Spark SQL for this layer,
+so the TPU build provides its own to be standalone)."""

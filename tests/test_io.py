@@ -1,0 +1,80 @@
+"""File IO tests: parquet/csv/orc write -> scan roundtrips through the
+engine (GpuParquetScan / writer suites' pattern)."""
+
+import os
+
+import pytest
+
+from spark_rapids_tpu import types as T
+
+from compare import assert_tpu_cpu_equal, tpu_session
+
+DATA = {
+    "i": (T.INT, [1, 2, None, 4, 5, 6, 7, None]),
+    "l": (T.LONG, [10, None, 30, 40, 50, 60, 70, 80]),
+    "d": (T.DOUBLE, [0.5, 1.5, None, 3.5, 4.5, 5.5, 6.5, 7.5]),
+    "s": (T.STRING, ["a", "bb", None, "dd", "", "ff", "gg", "hh"]),
+    "b": (T.BOOLEAN, [True, False, None, True, False, True, None, False]),
+}
+
+
+@pytest.fixture
+def pq_dir(tmp_path):
+    s = tpu_session()
+    df = s.create_dataframe(DATA, num_partitions=3)
+    out = str(tmp_path / "data_pq")
+    df.write_parquet(out)
+    assert os.path.exists(os.path.join(out, "_SUCCESS"))
+    return out
+
+
+def test_parquet_roundtrip(pq_dir):
+    def q(s):
+        return s.read.parquet(pq_dir).order_by("i", "l")
+    assert_tpu_cpu_equal(q, ignore_order=False)
+
+
+def test_parquet_scan_filter_agg(pq_dir):
+    from spark_rapids_tpu import functions as F
+
+    def q(s):
+        df = s.read.parquet(pq_dir)
+        return df.filter(df["i"].is_not_null()) \
+                 .group_by("b").agg(F.sum("l").alias("sum_l"))
+    assert_tpu_cpu_equal(q)
+
+
+def test_csv_roundtrip(tmp_path):
+    s = tpu_session()
+    data = {k: v for k, v in DATA.items() if k != "b"}
+    df = s.create_dataframe(data, num_partitions=2)
+    out = str(tmp_path / "data_csv")
+    df.write_csv(out)
+
+    def q(s2):
+        return s2.read.csv(out).order_by("i", "l")
+    assert_tpu_cpu_equal(q, ignore_order=False, approx=True)
+
+
+def test_orc_roundtrip(tmp_path):
+    s = tpu_session()
+    df = s.create_dataframe(DATA, num_partitions=2)
+    out = str(tmp_path / "data_orc")
+    df.write_orc(out)
+
+    def q(s2):
+        return s2.read.orc(out).order_by("i", "l")
+    assert_tpu_cpu_equal(q, ignore_order=False)
+
+
+def test_write_modes(tmp_path):
+    s = tpu_session()
+    df = s.create_dataframe(DATA)
+    out = str(tmp_path / "m")
+    df.write_parquet(out)
+    with pytest.raises(FileExistsError):
+        df.write_parquet(out, mode="error")
+    df.write_parquet(out, mode="overwrite")
+    df.write_parquet(out, mode="ignore")
+    got = s.read.parquet(out).count()
+    assert got == 8

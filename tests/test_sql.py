@@ -1,0 +1,74 @@
+"""SQL frontend tests (qa_nightly_select_test analogue): each query runs on
+the TPU and CPU engines and must agree."""
+
+import pytest
+
+from spark_rapids_tpu import types as T
+
+from compare import assert_tpu_cpu_equal
+
+STORE = {
+    "item": (T.INT, [1, 2, 3, 1, 2, 3, 1, None, 5, 5]),
+    "qty": (T.INT, [5, 30, 8, 2, 40, 1, 9, 3, None, 12]),
+    "price": (T.DOUBLE, [1.5, 2.0, 0.5, 3.0, None, 2.5, 1.0, 4.5, 2.2, 9.9]),
+    "name": (T.STRING, ["ham", "eggs", "spam", "ham", "eggs", "toast",
+                        "spam", None, "jam", "jam"]),
+}
+ITEMS = {
+    "item_sk": (T.INT, [1, 2, 3, 4]),
+    "category": (T.STRING, ["meat", "dairy", "meat", "bread"]),
+}
+
+
+def run_sql(q):
+    def build(s):
+        df1 = s.create_dataframe(STORE, num_partitions=3)
+        df1.create_or_replace_temp_view("store")
+        df2 = s.create_dataframe(ITEMS)
+        df2.create_or_replace_temp_view("items")
+        return s.sql(q)
+    return build
+
+
+@pytest.mark.parametrize("q", [
+    "SELECT item, qty FROM store",
+    "SELECT * FROM store WHERE qty > 5",
+    "SELECT item, qty * 2 AS dqty FROM store WHERE price IS NOT NULL",
+    "SELECT item, sum(qty) AS s, count(*) AS n FROM store GROUP BY item",
+    "SELECT name, avg(price) AS p FROM store GROUP BY name "
+    "HAVING count(*) > 1",
+    "SELECT item, qty FROM store ORDER BY qty DESC NULLS LAST, item LIMIT 5",
+    "SELECT s.item, i.category, qty FROM store s JOIN items i "
+    "ON s.item = i.item_sk WHERE qty < 50",
+    "SELECT item FROM store WHERE name LIKE 'h%'",
+    "SELECT item, CASE WHEN qty > 10 THEN 'big' ELSE 'small' END AS sz "
+    "FROM store",
+    "SELECT item FROM store WHERE item IN (1, 3, 5)",
+    "SELECT DISTINCT name FROM store",
+    "SELECT upper(name) AS u, length(name) AS l FROM store",
+    "SELECT item, qty FROM store WHERE qty BETWEEN 5 AND 30",
+    "SELECT cast(qty AS double) / 2 AS half FROM store WHERE qty IS NOT NULL",
+    "SELECT item, sum(qty) AS s FROM store GROUP BY item "
+    "ORDER BY s DESC NULLS LAST LIMIT 3",
+    "SELECT name, count(*) AS n FROM store GROUP BY name "
+    "UNION ALL SELECT category, count(*) AS n FROM items GROUP BY category",
+    "SELECT a.item, a.s FROM (SELECT item, sum(qty) AS s FROM store "
+    "GROUP BY item) a WHERE a.s > 10",
+    "SELECT item, row_number() OVER (PARTITION BY item ORDER BY qty) AS rn "
+    "FROM store WHERE item IS NOT NULL",
+])
+def test_sql_queries(q):
+    ordered = "ORDER BY" in q and "GROUP BY item \nORDER" not in q
+    assert_tpu_cpu_equal(run_sql(q), approx=True,
+                         ignore_order=not ordered)
+
+
+def test_sql_cross_and_semi():
+    for q in [
+        "SELECT s.item FROM store s LEFT SEMI JOIN items i "
+        "ON s.item = i.item_sk",
+        "SELECT s.item FROM store s LEFT ANTI JOIN items i "
+        "ON s.item = i.item_sk",
+        "SELECT s.item, i.item_sk FROM store s CROSS JOIN items i",
+    ]:
+        assert_tpu_cpu_equal(run_sql(q))
