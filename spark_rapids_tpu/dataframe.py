@@ -486,6 +486,22 @@ class DataFrame:
                                  for x, w in zip(r, widths)) + "|")
         print(line)
 
+    def cache(self) -> "DataFrame":
+        """Mark for caching: first execution materializes device batches
+        into the spillable-buffer catalog (df.cache() analogue; spills
+        device->host->disk under pressure instead of recompute)."""
+        if isinstance(self.plan, L.CachedRelation):
+            return self
+        return DataFrame(L.CachedRelation(self.plan, L.CacheHolder()),
+                         self.session)
+
+    persist = cache
+
+    def unpersist(self) -> "DataFrame":
+        if isinstance(self.plan, L.CachedRelation):
+            self.plan.holder.unpersist()
+        return self
+
     def create_or_replace_temp_view(self, name: str):
         self.session.register_view(name, self)
 

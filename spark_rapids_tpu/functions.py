@@ -228,6 +228,46 @@ def rand(seed: int = 42) -> Column:
     return Column(Rand(seed))
 
 
+# -- python UDFs -------------------------------------------------------------
+
+
+def udf(fn=None, return_type: T.DataType = T.DOUBLE):
+    """Row-at-a-time python UDF.  With
+    ``spark.rapids.sql.udfCompiler.enabled`` the planner attempts to compile
+    its bytecode to columnar expressions (udf-compiler analogue); otherwise
+    it runs on the host Arrow path."""
+    from spark_rapids_tpu.exprs.python_udf import PythonUDF
+
+    def wrap(f):
+        def call(*cols) -> Column:
+            exprs = [_to_expr(col(c) if isinstance(c, str) else c)
+                     for c in cols]
+            return Column(PythonUDF(f, return_type, *exprs))
+        call.__name__ = getattr(f, "__name__", "udf")
+        return call
+
+    if fn is None:
+        return wrap
+    return wrap(fn)
+
+
+def pandas_udf(fn=None, return_type: T.DataType = T.DOUBLE):
+    """Vectorized pandas UDF (GpuArrowEvalPythonExec path)."""
+    from spark_rapids_tpu.exprs.python_udf import PandasUDF
+
+    def wrap(f):
+        def call(*cols) -> Column:
+            exprs = [_to_expr(col(c) if isinstance(c, str) else c)
+                     for c in cols]
+            return Column(PandasUDF(f, return_type, *exprs))
+        call.__name__ = getattr(f, "__name__", "pandas_udf")
+        return call
+
+    if fn is None:
+        return wrap
+    return wrap(fn)
+
+
 # -- window ------------------------------------------------------------------
 
 

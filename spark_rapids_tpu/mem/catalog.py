@@ -123,14 +123,19 @@ class SpillableBatch:
         if self.tier == self.TIER_DEVICE:
             return self._device
         if self.tier == self.TIER_DISK:
-            self._host = self._read_disk()
+            host = self._read_disk()
+            if self._disk_path and os.path.exists(self._disk_path):
+                os.unlink(self._disk_path)
             self._disk_path = None
-            self.tier = self.TIER_HOST
-        if self.tier == self.TIER_HOST:
-            self._catalog.reserve(self.device_bytes, exclude=self.batch_id)
-            self._device = host_to_device(self._host, capacity=self._capacity)
-            self._host = None
-            self.tier = self.TIER_DEVICE
+        else:
+            host = self._host
+        # Mark device-resident BEFORE reserving so the budget loop cannot
+        # pick this handle as its own spill victim mid-rehydration.
+        self._host = None
+        self.tier = self.TIER_DEVICE
+        self._catalog.metrics["unspilled"] += 1
+        self._catalog.reserve(self.device_bytes, exclude=self.batch_id)
+        self._device = host_to_device(host, capacity=self._capacity)
         return self._device
 
     def close(self):

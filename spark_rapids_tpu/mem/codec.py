@@ -1,0 +1,58 @@
+"""Pluggable buffer compression codecs (TableCompressionCodec analogue,
+TableCompressionCodec.scala:42; codec selected by
+``spark.rapids.shuffle.compression.codec``, RapidsConf.scala:669).
+
+The reference ships only COPY (passthrough); here COPY plus zlib/lz4-style
+host codecs for spill/shuffle bytes.  Codecs operate on host ``bytes`` —
+device batches are staged host-side before the wire/disk anyway.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Callable, Dict, Tuple
+
+
+class Codec:
+    name = "copy"
+
+    def compress(self, data: bytes) -> bytes:
+        return data
+
+    def decompress(self, data: bytes, uncompressed_size: int) -> bytes:
+        return data
+
+
+class CopyCodec(Codec):
+    name = "copy"
+
+
+class ZlibCodec(Codec):
+    name = "zlib"
+
+    def __init__(self, level: int = 1):
+        self.level = level
+
+    def compress(self, data: bytes) -> bytes:
+        return zlib.compress(data, self.level)
+
+    def decompress(self, data: bytes, uncompressed_size: int) -> bytes:
+        return zlib.decompress(data)
+
+
+_CODECS: Dict[str, Callable[[], Codec]] = {
+    "copy": CopyCodec,
+    "uncompressed": CopyCodec,
+    "zlib": ZlibCodec,
+}
+
+
+def get_codec(name: str) -> Codec:
+    try:
+        return _CODECS[name.lower()]()
+    except KeyError:
+        raise ValueError(f"unknown compression codec: {name}") from None
+
+
+def register_codec(name: str, factory: Callable[[], Codec]):
+    _CODECS[name.lower()] = factory

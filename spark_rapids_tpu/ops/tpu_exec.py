@@ -27,9 +27,35 @@ from spark_rapids_tpu.exprs.aggregates import AggregateExpression
 from spark_rapids_tpu.exprs.base import DevVal, Expression, SortOrder, TpuEvalCtx
 from spark_rapids_tpu.kernels.groupby import groupby_aggregate
 from spark_rapids_tpu.kernels.join import cross_join, hash_join
-from spark_rapids_tpu.kernels.layout import compact, concat_pair, take_head
+from spark_rapids_tpu.kernels.layout import (
+    compact, concat_pair, gather_rows, take_head,
+)
 from spark_rapids_tpu.kernels.sort import sort_batch
 from spark_rapids_tpu.plan.physical import ExecContext, PhysicalOp, TpuExec
+
+
+def shrink_to_fit(batch: ColumnBatch) -> ColumnBatch:
+    """Re-bucket a sparse batch down to its live-row count.
+
+    The padded-capacity model means ops like filter/aggregate can leave
+    batches with few live rows in huge buffers; every downstream kernel then
+    pays O(capacity).  At pipeline breaks (exchanges, agg partials) we pay
+    one host sync + gather to move to the right power-of-two bucket — the
+    CoalesceGoal/TargetSize analogue in reverse (GpuCoalesceBatches.scala).
+    """
+    n = batch.host_num_rows()
+    cap = round_up_capacity(max(n, 1))
+    if batch.capacity <= cap * 2:
+        return batch
+    byte_caps = []
+    for c in batch.columns:
+        if c.is_string:
+            off = jax.device_get(c.offsets)
+            byte_caps.append(round_up_capacity(max(int(off[n]), 16),
+                                               minimum=16))
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    return gather_rows(batch, idx, jnp.asarray(n, jnp.int32),
+                       out_capacity=cap, out_byte_caps=byte_caps or None)
 
 
 def _concat_all(batches: List[ColumnBatch], schema: T.Schema
@@ -363,14 +389,14 @@ class TpuHashAggregateExec(TpuExec):
                         return
                     # keyless reduction on empty input -> SQL default row
                     merged = empty_device_batch(child_schema)
-                yield self._run(merged)
+                yield shrink_to_fit(self._run(merged))
         else:
             # update mode: aggregate each batch, then combine this
             # partition's partials: concat + buffer-merge (the reference's
             # concatenateBatches + merge-aggregate loop,
             # aggregate.scala:434-492).
             def gen(part):
-                partials = [self._run(db) for db in part
+                partials = [shrink_to_fit(self._run(db)) for db in part
                             if db.host_num_rows()]
                 if not partials:
                     return
@@ -378,7 +404,7 @@ class TpuHashAggregateExec(TpuExec):
                     yield partials[0]
                     return
                 merged = _concat_all(partials, self.output_schema)
-                yield self._merge_run(merged)
+                yield shrink_to_fit(self._merge_run(merged))
 
         return [gen(p) for p in self.children[0].partitions(ctx)]
 
@@ -560,3 +586,43 @@ class TpuSampleExec(TpuExec):
 
         return [gen(i, p)
                 for i, p in enumerate(self.children[0].partitions(ctx))]
+
+
+class TpuCachedScanExec(TpuExec):
+    """Reads (and on first run populates) a CacheHolder of spillable device
+    batches (df.cache() analogue — SURVEY.md section 5 checkpoint/resume:
+    cached batches are evictable through the device->host->disk tiers)."""
+
+    def __init__(self, holder, child: Optional[PhysicalOp],
+                 schema: T.Schema):
+        super().__init__([child] if child is not None else [], schema)
+        self.holder = holder
+
+    def describe(self):
+        return "TpuCachedScan"
+
+    def num_partitions(self, ctx):
+        if self.holder.is_materialized:
+            return len(self.holder.partitions)
+        return self.children[0].num_partitions(ctx)
+
+    def _materialize(self, ctx):
+        from spark_rapids_tpu.runtime.device import DeviceRuntime
+        catalog = DeviceRuntime.get(ctx.conf).catalog
+        parts = []
+        for p in self.children[0].partitions(ctx):
+            handles = []
+            for db in p:
+                handles.append(catalog.register(shrink_to_fit(db)))
+            parts.append(handles)
+        self.holder.partitions = parts
+
+    def partitions(self, ctx):
+        if not self.holder.is_materialized:
+            self._materialize(ctx)
+
+        def gen(handles):
+            for h in handles:
+                yield h.get()
+
+        return [gen(p) for p in self.holder.partitions]

@@ -20,7 +20,7 @@ from spark_rapids_tpu import types as T
 from spark_rapids_tpu.batch import (
     ColumnBatch, HostBatch, HostColumn, device_to_host,
 )
-from spark_rapids_tpu.kernels.layout import compact
+from spark_rapids_tpu.kernels.layout import compact, gather_rows
 from spark_rapids_tpu.parallel.partitioning import (
     Partitioning, RangePartitioning, SinglePartitioning,
 )
@@ -87,7 +87,8 @@ class TpuShuffleExchangeExec(TpuExec):
     def __init__(self, partitioning: Partitioning, child: PhysicalOp):
         super().__init__([child], child.output_schema)
         self.partitioning = partitioning
-        self._split = jax.jit(self._split_impl, static_argnames=("n",))
+        self._sort_by_pid = jax.jit(self._sort_by_pid_impl,
+                                    static_argnames=("n",))
 
     def describe(self):
         p = self.partitioning
@@ -96,9 +97,25 @@ class TpuShuffleExchangeExec(TpuExec):
     def num_partitions(self, ctx):
         return self.partitioning.num_partitions
 
-    def _split_impl(self, batch: ColumnBatch, part_index, n: int):
+    def _sort_by_pid_impl(self, batch: ColumnBatch, part_index, n: int):
+        """One pass: rows reordered so each target partition's rows are
+        contiguous (the GPU `Table.partition` + contiguousSplit shape,
+        GpuPartitioning.scala:44-117).  Returns (sorted batch, per-target
+        row counts, per-target byte totals for each string column)."""
+        cap = batch.capacity
         ids = self.partitioning.device_partition_ids(batch, part_index)
-        return [compact(batch, ids == p) for p in range(n)]
+        live = jnp.arange(cap, dtype=jnp.int32) < batch.num_rows
+        ids = jnp.where(live, ids, n)
+        order = jnp.argsort(ids, stable=True).astype(jnp.int32)
+        sorted_batch = gather_rows(batch, order, batch.num_rows)
+        counts = jnp.zeros(n + 1, jnp.int32).at[ids].add(1)[:n]
+        byte_totals = []
+        for c in batch.columns:
+            if c.is_string:
+                lens = (c.offsets[1:] - c.offsets[:-1]).astype(jnp.int64)
+                byte_totals.append(jax.ops.segment_sum(
+                    lens, ids, num_segments=n + 1)[:n])
+        return sorted_batch, counts, byte_totals
 
     def partitions(self, ctx):
         n = self.partitioning.num_partitions
@@ -111,15 +128,36 @@ class TpuShuffleExchangeExec(TpuExec):
         if isinstance(self.partitioning, SinglePartitioning):
             flat = [b for part in all_batches for b in part]
             return [iter(flat)]
+        from spark_rapids_tpu.batch import round_up_capacity
         out: List[List[ColumnBatch]] = [[] for _ in range(n)]
-        rows_metric = ctx.metric(self.op_id, "partitionRows")
         for pi, batches in enumerate(all_batches):
             for db in batches:
-                pieces = self._split(db, pi, n) \
-                    if not isinstance(self.partitioning, RangePartitioning) \
-                    else self._split_impl(db, pi, n)
+                sorted_batch, counts, byte_totals = \
+                    self._sort_by_pid(db, pi, n) \
+                    if not isinstance(self.partitioning,
+                                      RangePartitioning) \
+                    else self._sort_by_pid_impl(db, pi, n)
+                counts_h = np.asarray(jax.device_get(counts))
+                bytes_h = [np.asarray(jax.device_get(b))
+                           for b in byte_totals]
+                offset = 0
                 for p in range(n):
-                    out[p].append(pieces[p])
+                    cnt = int(counts_h[p])
+                    if cnt == 0:
+                        continue
+                    pcap = round_up_capacity(cnt)
+                    idx = offset + jnp.arange(pcap, dtype=jnp.int32)
+                    bcaps = [round_up_capacity(max(int(bh[p]), 16),
+                                               minimum=16)
+                             for bh in bytes_h]
+                    from spark_rapids_tpu.kernels.layout import gather_rows \
+                        as _gather
+                    piece = _gather(sorted_batch, idx,
+                                    jnp.asarray(cnt, jnp.int32),
+                                    out_capacity=pcap,
+                                    out_byte_caps=bcaps or None)
+                    out[p].append(piece)
+                    offset += cnt
         return [iter(p) for p in out]
 
 

@@ -326,3 +326,39 @@ class WriteFile(LogicalPlan):
     @property
     def schema(self):
         return T.Schema([])
+
+
+class CacheHolder:
+    """Materialized cache state shared by all DataFrames over a cached plan
+    (the GPU df.cache() analogue; reference: ParquetCachedBatchSerializer,
+    shims/spark310 — here cached batches live as catalog-registered
+    spillable device batches, so they flow device->host->disk under
+    memory pressure instead of being re-encoded as parquet blobs)."""
+
+    def __init__(self):
+        self.partitions = None  # List[List[SpillableBatch]] once filled
+
+    @property
+    def is_materialized(self) -> bool:
+        return self.partitions is not None
+
+    def unpersist(self):
+        if self.partitions:
+            for part in self.partitions:
+                for h in part:
+                    h.close()
+        self.partitions = None
+
+
+class CachedRelation(LogicalPlan):
+    def __init__(self, child: LogicalPlan, holder: CacheHolder):
+        self.children = (child,)
+        self.holder = holder
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def describe(self):
+        state = "materialized" if self.holder.is_materialized else "lazy"
+        return f"CachedRelation({state})"

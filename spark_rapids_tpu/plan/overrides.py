@@ -132,6 +132,8 @@ class TpuOverrides:
             # Scans decode on host by design (SURVEY.md section 7: host Arrow
             # decode staged into HBM); they are CPU execs + HostToDevice.
             meta.will_not_work("scans decode host-side (by design)")
+        elif isinstance(node, L.CachedRelation):
+            pass  # cached device batches are always TPU-resident
         elif isinstance(node, L.Project):
             meta.check_exprs(*node.exprs)
         elif isinstance(node, L.Filter):
@@ -173,6 +175,8 @@ class TpuOverrides:
     # -------------------------------------------------------------- convert
 
     def apply(self, plan: L.LogicalPlan) -> PhysicalOp:
+        if self.conf.get("spark.rapids.sql.udfCompiler.enabled", False):
+            plan = _compile_plan_udfs(plan)
         meta = PlanMeta(plan, self.conf)
         self.tag(meta)
         self.last_explain = "\n".join(meta.explain_lines())
@@ -195,6 +199,11 @@ class TpuOverrides:
         if isinstance(node, L.FileScan):
             from spark_rapids_tpu.io.scan import CpuFileScanExec
             return CpuFileScanExec(node, self.conf)
+        if isinstance(node, L.CachedRelation):
+            return X.TpuCachedScanExec(
+                node.holder,
+                None if node.holder.is_materialized else
+                _to_device(conv[0]), node.schema)
         if isinstance(node, L.Range):
             if on_tpu:
                 return X.TpuRangeExec(node.start, node.end, node.step,
@@ -403,6 +412,34 @@ class _FakeNode:
     @property
     def schema(self):
         return self._schema
+
+
+def _compile_plan_udfs(plan: L.LogicalPlan) -> L.LogicalPlan:
+    """udf-compiler analogue (udf-compiler/Plugin.scala:36-94): rewrite
+    PythonUDF calls into engine expressions where bytecode compilation
+    succeeds; silently keep the UDF (and its CPU fallback) otherwise."""
+    from spark_rapids_tpu.exprs.python_udf import PythonUDF
+    from spark_rapids_tpu.udf.compiler import CannotCompile, compile_udf
+
+    def fix_expr(e):
+        def fn(node):
+            if isinstance(node, PythonUDF) and type(node) is PythonUDF:
+                try:
+                    return compile_udf(node.fn, list(node.children))
+                except CannotCompile:
+                    return node
+            return node
+        return e.transform_up(fn)
+
+    new_children = [_compile_plan_udfs(c) for c in plan.children]
+    if isinstance(plan, L.Project):
+        return L.Project([fix_expr(e) for e in plan.exprs], plan.names,
+                         new_children[0])
+    if isinstance(plan, L.Filter):
+        return L.Filter(fix_expr(plan.condition), new_children[0])
+    # other nodes: rebuild children in place
+    plan.children = tuple(new_children)
+    return plan
 
 
 def _to_device(op: PhysicalOp) -> PhysicalOp:

@@ -1,0 +1,13 @@
+"""User-defined functions.
+
+Two paths, mirroring the reference:
+
+* :mod:`udf.compiler` — the udf-compiler analogue (SURVEY.md section 2.8):
+  decompiles simple *Python* row-UDF bytecode into engine expression trees so
+  they run columnar on the TPU (the reference decompiles Scala/JVM bytecode
+  to Catalyst, udf-compiler/CatalystExpressionBuilder.scala:45).
+* :mod:`udf.pandas_exec` — GpuArrowEvalPythonExec analogue
+  (GpuArrowEvalPythonExec.scala:484): batches leave the device as Arrow,
+  a pandas function runs on host (semaphore released while it runs), and
+  results are staged back to HBM.
+"""

@@ -1,0 +1,302 @@
+"""Python row-UDF bytecode -> expression-tree compiler.
+
+Reference analogue: the udf-compiler module (SURVEY.md section 2.8) walks JVM
+bytecode of Scala lambdas (CFG.scala basic blocks + an opcode interpreter)
+and rebuilds Catalyst expressions.  Here the same idea over CPython bytecode:
+a tiny abstract interpreter executes the UDF's code object symbolically,
+mapping stack operations to engine expressions.  Anything it cannot model
+raises :class:`CannotCompile` and the caller silently falls back to the
+pandas path (the reference's silent-fallback behavior,
+udf-compiler/Plugin.scala:36-94).
+
+Supported: arithmetic (+,-,*,/,%,**), comparisons, and/or/not chains built
+from conditional jumps, if/else expressions, abs/min/max/len over strings,
+str methods (upper/lower/strip/startswith/endswith), math.sqrt/log/exp,
+constants, multiple arguments.  No loops, no stores, no external state.
+"""
+
+from __future__ import annotations
+
+import dis
+import math
+import types
+from typing import Any, Dict, List, Optional
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exprs.base import Expression, Literal
+
+
+class CannotCompile(Exception):
+    pass
+
+
+_BINOPS = {
+    "+": "Add", "-": "Subtract", "*": "Multiply", "/": "Divide",
+    "%": "Remainder", "**": "Pow",
+}
+_CMPOPS = {
+    "==": "Equals", "!=": "NotEquals", "<": "LessThan",
+    "<=": "LessThanOrEqual", ">": "GreaterThan", ">=": "GreaterThanOrEqual",
+}
+
+
+def _binop(opname: str, a: Expression, b: Expression) -> Expression:
+    from spark_rapids_tpu.exprs import arithmetic as AR
+    from spark_rapids_tpu.exprs import mathexprs as M
+    if opname == "**":
+        return M.Pow(a, b)
+    cls = getattr(AR, _BINOPS[opname])
+    return cls(a, b)
+
+
+def _cmpop(opname: str, a: Expression, b: Expression) -> Expression:
+    from spark_rapids_tpu.exprs import predicates as P
+    return getattr(P, _CMPOPS[opname])(a, b)
+
+
+_GLOBAL_FUNCS = {
+    abs: lambda args: _abs(args[0]),
+    len: lambda args: _len(args[0]),
+    math.sqrt: lambda args: _math1("Sqrt", args[0]),
+    math.log: lambda args: _math1("Log", args[0]),
+    math.exp: lambda args: _math1("Exp", args[0]),
+    math.floor: lambda args: _math1("Floor", args[0]),
+    math.ceil: lambda args: _math1("Ceil", args[0]),
+}
+
+_STR_METHODS = {
+    "upper": "Upper", "lower": "Lower", "strip": "StringTrim",
+    "lstrip": "StringTrimLeft", "rstrip": "StringTrimRight",
+}
+
+
+def _abs(e):
+    from spark_rapids_tpu.exprs.arithmetic import Abs
+    return Abs(e)
+
+
+def _len(e):
+    from spark_rapids_tpu.exprs.strings import Length
+    return Length(e)
+
+
+def _math1(name, e):
+    from spark_rapids_tpu.exprs import mathexprs as M
+    return getattr(M, name)(e)
+
+
+class _Method:
+    def __init__(self, obj: Expression, name: str):
+        self.obj = obj
+        self.name = name
+
+
+class _Compiler:
+    """Symbolic evaluator over a code object's bytecode (single pass with
+    branch forking for conditionals — the CFG/State analogue)."""
+
+    def __init__(self, code: types.CodeType, arg_exprs: List[Expression],
+                 globals_: Dict[str, Any]):
+        self.code = code
+        self.instrs = list(dis.get_instructions(code))
+        self.by_offset = {ins.offset: i for i, ins in enumerate(self.instrs)}
+        self.args = {code.co_varnames[i]: e
+                     for i, e in enumerate(arg_exprs)}
+        self.globals = globals_
+
+    def run(self) -> Expression:
+        return self._exec(0, [])
+
+    def _exec(self, idx: int, stack: List[Any]) -> Expression:
+        """Interpret from instruction idx until RETURN; returns result."""
+        from spark_rapids_tpu.exprs import predicates as P
+        from spark_rapids_tpu.exprs.conditional import If
+        stack = list(stack)
+        i = idx
+        guard = 0
+        while i < len(self.instrs):
+            guard += 1
+            if guard > 10000:
+                raise CannotCompile("bytecode too long")
+            ins = self.instrs[i]
+            op = ins.opname
+            if op in ("RESUME", "PRECALL", "CACHE", "NOP", "PUSH_NULL",
+                      "COPY_FREE_VARS", "MAKE_CELL", "NOT_TAKEN"):
+                i += 1
+                continue
+            if op in ("LOAD_FAST", "LOAD_FAST_CHECK", "LOAD_FAST_BORROW"):
+                if ins.argval not in self.args:
+                    raise CannotCompile(f"unknown local {ins.argval}")
+                stack.append(self.args[ins.argval])
+                i += 1
+                continue
+            if op in ("LOAD_FAST_LOAD_FAST", "LOAD_FAST_BORROW_LOAD_FAST_BORROW"):
+                a, b = ins.argval
+                for nm in (a, b):
+                    if nm not in self.args:
+                        raise CannotCompile(f"unknown local {nm}")
+                    stack.append(self.args[nm])
+                i += 1
+                continue
+            if op == "LOAD_CONST":
+                stack.append(Literal(ins.argval)
+                             if ins.argval is not None or True
+                             else ins.argval)
+                i += 1
+                continue
+            if op in ("LOAD_GLOBAL", "LOAD_DEREF", "LOAD_NAME"):
+                name = ins.argval
+                if name in self.globals:
+                    stack.append(self.globals[name])
+                elif name in __builtins__ if isinstance(__builtins__, dict) \
+                        else hasattr(__builtins__, name):
+                    b = __builtins__[name] if isinstance(__builtins__, dict) \
+                        else getattr(__builtins__, name)
+                    stack.append(b)
+                else:
+                    raise CannotCompile(f"unknown global {name}")
+                i += 1
+                continue
+            if op in ("LOAD_ATTR", "LOAD_METHOD"):
+                obj = stack.pop()
+                name = ins.argval
+                if isinstance(obj, Expression):
+                    if name not in _STR_METHODS:
+                        raise CannotCompile(f"method {name}")
+                    stack.append(_Method(obj, name))
+                elif isinstance(obj, types.ModuleType):
+                    stack.append(getattr(obj, name))
+                else:
+                    raise CannotCompile(f"attr on {obj!r}")
+                i += 1
+                continue
+            if op == "BINARY_OP":
+                b, a = stack.pop(), stack.pop()
+                sym = ins.argrepr.strip().rstrip("=")
+                if sym not in _BINOPS:
+                    raise CannotCompile(f"binop {ins.argrepr}")
+                stack.append(_binop(sym, _as_expr(a), _as_expr(b)))
+                i += 1
+                continue
+            if op == "COMPARE_OP":
+                b, a = stack.pop(), stack.pop()
+                sym = ins.argrepr.split()[0]
+                if sym not in _CMPOPS:
+                    raise CannotCompile(f"cmp {ins.argrepr}")
+                stack.append(_cmpop(sym, _as_expr(a), _as_expr(b)))
+                i += 1
+                continue
+            if op == "UNARY_NEGATIVE":
+                from spark_rapids_tpu.exprs.arithmetic import UnaryMinus
+                stack.append(UnaryMinus(_as_expr(stack.pop())))
+                i += 1
+                continue
+            if op == "UNARY_NOT":
+                stack.append(P.Not(_as_expr(stack.pop())))
+                i += 1
+                continue
+            if op in ("CALL", "CALL_FUNCTION", "CALL_METHOD"):
+                argc = ins.arg or 0
+                args = [stack.pop() for _ in range(argc)][::-1]
+                fn = stack.pop()
+                if isinstance(fn, Literal):
+                    raise CannotCompile("calling a literal")
+                if isinstance(fn, _Method):
+                    stack.append(self._call_method(fn, args))
+                elif fn in _GLOBAL_FUNCS:
+                    stack.append(_GLOBAL_FUNCS[fn](
+                        [_as_expr(a) for a in args]))
+                elif fn in (min, max) if callable(fn) else False:
+                    stack.append(self._minmax(fn, args))
+                else:
+                    raise CannotCompile(f"call {fn!r}")
+                i += 1
+                continue
+            if op in ("POP_JUMP_IF_FALSE", "POP_JUMP_IF_TRUE",
+                      "POP_JUMP_FORWARD_IF_FALSE", "POP_JUMP_FORWARD_IF_TRUE"):
+                cond = _as_expr(stack.pop())
+                target = self.by_offset[ins.argval]
+                take_true_first = "IF_FALSE" in op
+                # fork: fallthrough vs jump
+                ft = self._exec(i + 1, stack)
+                jp = self._exec(target, stack)
+                from spark_rapids_tpu.exprs.conditional import If
+                if take_true_first:
+                    return If(cond, ft, jp)
+                return If(cond, jp, ft)
+            if op in ("JUMP_IF_FALSE_OR_POP", "JUMP_IF_TRUE_OR_POP"):
+                cond = _as_expr(stack.pop())
+                target = self.by_offset[ins.argval]
+                ft = self._exec(i + 1, stack)
+                jp_stack = stack + [cond]
+                jp = self._exec(target, jp_stack)
+                from spark_rapids_tpu.exprs.conditional import If
+                if "IF_FALSE" in op:
+                    return If(cond, ft, jp)
+                return If(cond, jp, ft)
+            if op in ("JUMP_FORWARD", "JUMP_BACKWARD", "JUMP_ABSOLUTE"):
+                if op == "JUMP_BACKWARD":
+                    raise CannotCompile("loop")
+                i = self.by_offset[ins.argval]
+                continue
+            if op in ("TO_BOOL",):
+                i += 1
+                continue
+            if op in ("RETURN_VALUE",):
+                return _as_expr(stack.pop())
+            if op == "RETURN_CONST":
+                return Literal(ins.argval)
+            raise CannotCompile(f"opcode {op}")
+        raise CannotCompile("fell off end of bytecode")
+
+    def _call_method(self, m: _Method, args) -> Expression:
+        from spark_rapids_tpu.exprs import strings as S
+        if m.name in _STR_METHODS and not args:
+            return getattr(S, _STR_METHODS[m.name])(m.obj)
+        if m.name == "startswith" and len(args) == 1:
+            return S.StringStartsWith(m.obj, _as_expr(args[0]))
+        if m.name == "endswith" and len(args) == 1:
+            return S.StringEndsWith(m.obj, _as_expr(args[0]))
+        raise CannotCompile(f"method {m.name}/{len(args)}")
+
+    def _minmax(self, fn, args) -> Expression:
+        from spark_rapids_tpu.exprs.conditional import If
+        from spark_rapids_tpu.exprs import predicates as P
+        if len(args) != 2:
+            raise CannotCompile("min/max arity")
+        a, b = _as_expr(args[0]), _as_expr(args[1])
+        if fn is min:
+            return If(P.LessThanOrEqual(a, b), a, b)
+        return If(P.GreaterThanOrEqual(a, b), a, b)
+
+
+def _as_expr(v) -> Expression:
+    if isinstance(v, Expression):
+        return v
+    raise CannotCompile(f"non-expression value {v!r} on stack")
+
+
+def compile_udf(fn, arg_exprs: List[Expression]) -> Expression:
+    """Compile a python function of N scalars into an expression over the
+    given argument expressions.  Raises CannotCompile on anything fancy."""
+    if not isinstance(fn, types.FunctionType):
+        raise CannotCompile("not a plain python function")
+    if fn.__code__.co_argcount != len(arg_exprs):
+        raise CannotCompile("arity mismatch")
+    if fn.__closure__:
+        # allow closures over plain constants
+        free = {}
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            v = cell.cell_contents
+            if isinstance(v, (int, float, str, bool)) or v is None:
+                free[name] = Literal(v)
+            elif isinstance(v, types.ModuleType) or callable(v):
+                free[name] = v
+            else:
+                raise CannotCompile(f"closure over {type(v)}")
+        g = dict(fn.__globals__)
+        g.update(free)
+    else:
+        g = fn.__globals__
+    comp = _Compiler(fn.__code__, arg_exprs, g)
+    return comp.run()

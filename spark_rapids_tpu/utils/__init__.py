@@ -1,0 +1,1 @@
+"""Shared utilities: tracing/profiler ranges, codecs."""

@@ -1,0 +1,36 @@
+"""Profiler ranges fused with metrics.
+
+Reference analogue: NvtxWithMetrics (NvtxWithMetrics.scala:27-36) — one
+``with`` block feeds both the profiler timeline and a SQL metric.  On TPU the
+profiler side is XProf via ``jax.profiler.TraceAnnotation`` (the XLA runtime
+exports these through the PJRT profiler C API, SURVEY.md section 2.9 NVTX
+row); the metric side is the ExecContext Metric objects.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+import jax.profiler
+
+
+@contextlib.contextmanager
+def trace_range(name: str, metric=None):
+    """Profiler range + optional elapsed-nanos metric accumulation."""
+    t0 = time.monotonic_ns()
+    with jax.profiler.TraceAnnotation(name):
+        yield
+    if metric is not None:
+        metric.add(time.monotonic_ns() - t0)
+
+
+def start_profile(logdir: str):
+    """Begin an XProf capture (nsys-capture analogue,
+    docs/dev/nvtx_profiling.md)."""
+    jax.profiler.start_trace(logdir)
+
+
+def stop_profile():
+    jax.profiler.stop_trace()

@@ -222,3 +222,24 @@ def test_enforce_tpu_mode():
     df = make_df(s).filter(Column(ColumnRef("a")) > 1).select("a", "s")
     # should not raise: everything lands on TPU
     df.collect()
+
+
+def test_large_batch_shrink_path():
+    """Exercise shrink_to_fit + the sorted exchange split (big sparse
+    batches; regression: shrink_to_fit import bug only hit at scale)."""
+    import numpy as np
+    from spark_rapids_tpu import functions as F
+    n = 40_000
+    rng = np.random.RandomState(1)
+    data = {
+        "k": (T.INT, rng.randint(0, 50, n)),
+        "v": (T.LONG, rng.randint(0, 1000, n)),
+        "s": (T.STRING, [f"s{int(x)}" for x in rng.randint(0, 50, n)]),
+    }
+
+    def q(s):
+        df = s.create_dataframe(data, num_partitions=3)
+        return df.filter(df["v"] < 40) \
+                 .group_by("k", "s").agg(F.sum("v").alias("sv"),
+                                         F.count("v").alias("cv"))
+    assert_tpu_cpu_equal(q)

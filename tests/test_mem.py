@@ -1,0 +1,86 @@
+"""Memory subsystem tests (RapidsBufferCatalogSuite / DeviceMemoryStore /
+DiskStore suites' pattern): spill tiers, budgets, cache, codecs."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.batch import HostBatch, device_to_host, host_to_device
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.mem.catalog import BufferCatalog, SpillableBatch
+from spark_rapids_tpu.mem.codec import get_codec
+
+from compare import tpu_session
+from conftest import assert_batches_equal
+
+DATA = {
+    "x": (T.INT, [1, 2, 3, None, 5]),
+    "s": (T.STRING, ["aa", None, "cc", "dd", ""]),
+}
+
+
+def make_catalog(device_budget, host_budget=1 << 20):
+    conf = RapidsConf({
+        "spark.rapids.memory.tpu.spillBudgetBytes": device_budget,
+        "spark.rapids.memory.host.spillStorageSize": host_budget,
+    })
+    return BufferCatalog(conf)
+
+
+def batch():
+    return host_to_device(HostBatch.from_pydict(DATA))
+
+
+def test_register_and_get():
+    cat = make_catalog(1 << 30)
+    h = cat.register(batch())
+    assert h.tier == SpillableBatch.TIER_DEVICE
+    got = device_to_host(h.get()).to_pydict()
+    assert_batches_equal(HostBatch.from_pydict(DATA).to_pydict(), got)
+    h.close()
+    assert cat.device_bytes_in_use() == 0
+
+
+def test_spill_to_host_on_budget():
+    cat = make_catalog(device_budget=50)  # tiny: forces spill
+    h1 = cat.register(batch(), priority=1)
+    h2 = cat.register(batch(), priority=2)
+    # lowest priority spilled first
+    assert h1.tier == SpillableBatch.TIER_HOST
+    assert cat.metrics["spilled_to_host"] >= 1
+    # unspill transparently
+    got = device_to_host(h1.get()).to_pydict()
+    assert_batches_equal(HostBatch.from_pydict(DATA).to_pydict(), got)
+
+
+def test_spill_to_disk_when_host_full():
+    cat = make_catalog(device_budget=1, host_budget=1)
+    h1 = cat.register(batch(), priority=1)
+    cat.register(batch(), priority=2)
+    assert cat.metrics["spilled_to_disk"] >= 1
+    got = device_to_host(h1.get()).to_pydict()
+    assert_batches_equal(HostBatch.from_pydict(DATA).to_pydict(), got)
+
+
+def test_codecs_roundtrip():
+    payload = b"hello world " * 100
+    for name in ("copy", "zlib"):
+        c = get_codec(name)
+        enc = c.compress(payload)
+        assert c.decompress(enc, len(payload)) == payload
+    with pytest.raises(ValueError):
+        get_codec("nope")
+
+
+def test_dataframe_cache():
+    s = tpu_session()
+    df = s.create_dataframe(DATA, num_partitions=2) \
+        .filter(s.create_dataframe(DATA)["x"].is_not_null())
+    cached = df.cache()
+    r1 = sorted(map(str, cached.collect()))
+    # second run must hit the materialized cache (same results)
+    r2 = sorted(map(str, cached.collect()))
+    assert r1 == r2
+    assert cached.plan.holder.is_materialized
+    cached.unpersist()
+    assert not cached.plan.holder.is_materialized

@@ -1,0 +1,109 @@
+"""UDF tests: bytecode compiler (udf-compiler analogue) + pandas/row UDF
+fallback path (udf_cudf_test / GpuArrowEvalPythonExec analogues)."""
+
+import math
+
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu.exprs.base import ColumnRef, Literal
+from spark_rapids_tpu.udf.compiler import CannotCompile, compile_udf
+
+from compare import assert_tpu_cpu_equal, tpu_session
+
+DATA = {
+    "x": (T.INT, [1, -2, 3, None, 5, -6]),
+    "y": (T.DOUBLE, [0.5, 1.5, None, 3.5, 4.5, 5.5]),
+    "s": (T.STRING, ["Ham", "spam", None, "Eggs", "", "Toast"]),
+}
+
+
+# -- compiler unit tests -----------------------------------------------------
+
+
+def test_compile_arith():
+    e = compile_udf(lambda a, b: a * 2 + b - 1,
+                    [ColumnRef("x", T.INT), ColumnRef("y", T.DOUBLE)])
+    assert "Add" in type(e).__name__ or e is not None
+
+
+def test_compile_conditional():
+    e = compile_udf(lambda a: a + 1 if a > 0 else a - 1,
+                    [ColumnRef("x", T.INT)])
+    assert type(e).__name__ == "If"
+
+
+def test_compile_abs_and_math():
+    compile_udf(lambda a: abs(a) + math.sqrt(a), [ColumnRef("y", T.DOUBLE)])
+
+
+def test_compile_string_methods():
+    e = compile_udf(lambda s: s.upper(), [ColumnRef("s", T.STRING)])
+    assert type(e).__name__ == "Upper"
+
+
+def test_compile_rejects_loops():
+    def f(a):
+        t = 0
+        for i in range(3):
+            t += a
+        return t
+    with pytest.raises(CannotCompile):
+        compile_udf(f, [ColumnRef("x", T.INT)])
+
+
+def test_compile_closure_constant():
+    k = 10
+
+    def f(a):
+        return a + k
+    e = compile_udf(f, [ColumnRef("x", T.INT)])
+    assert e is not None
+
+
+# -- end-to-end --------------------------------------------------------------
+
+
+def test_row_udf_fallback_path():
+    def q(s):
+        df = s.create_dataframe(DATA, num_partitions=2)
+        my = F.udf(lambda a: None if a is None else a * 3 + 1,
+                   return_type=T.LONG)
+        return df.with_column("t", my(df["x"]))
+    assert_tpu_cpu_equal(q)
+
+
+def test_pandas_udf():
+    def q(s):
+        df = s.create_dataframe(DATA, num_partitions=2)
+        my = F.pandas_udf(lambda a: a * 2.0 + 1.0, return_type=T.DOUBLE)
+        return df.with_column("t", my(df["y"]))
+    assert_tpu_cpu_equal(q, approx=True)
+
+
+def test_compiled_udf_runs_on_tpu():
+    s = tpu_session(**{"spark.rapids.sql.udfCompiler.enabled": True})
+    df = s.create_dataframe(DATA, num_partitions=2)
+    my = F.udf(lambda a: a * 2 + 1, return_type=T.INT)
+    out = df.with_column("t", my(df["x"]))
+    rows = out.collect()
+    # compiled projection must be on the TPU: no PythonUDF fallback reason
+    assert "PythonUDF" not in s.last_explain
+    got = {r[0]: r[3] for r in rows}
+    assert got[1] == 3 and got[-2] == -3
+    assert got[None] is None
+
+
+def test_uncompilable_udf_falls_back():
+    s = tpu_session(**{"spark.rapids.sql.udfCompiler.enabled": True})
+    df = s.create_dataframe(DATA, num_partitions=2)
+
+    def weird(a):
+        return hash(str(a)) % 97  # hash() not compilable
+
+    my = F.udf(weird, return_type=T.INT)
+    out = df.with_column("t", my(df["x"]))
+    rows = out.collect()
+    assert "cannot run on TPU" in s.last_explain
+    assert len(rows) == 6
