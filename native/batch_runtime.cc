@@ -1,0 +1,269 @@
+// Native host runtime for spark_rapids_tpu.
+//
+// TPU-native replacements for the reference's JNI-backed host runtime
+// (SURVEY.md section 2.9):
+//   * columnar batch wire serializer   <- JCudfSerialization
+//     (GpuColumnarBatchSerializer.scala:84-95 wire role): one contiguous
+//     framed buffer holding all column buffers, used by the host shuffle
+//     fallback, broadcast and disk spill.
+//   * aligned host staging arena       <- PinnedMemoryPool
+//     (GpuDeviceManager.scala:244-250): recycling aligned allocator for
+//     host<->HBM staging buffers.
+//   * murmur3_x86_32 row hasher        <- spark-compatible hash partitioning
+//     on the host path (GpuHashPartitioning.scala murmur3 contract).
+//
+// Exposed as a C ABI consumed via ctypes (no pybind11 in the image).
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Wire format:
+//   u32 magic 'TPUB'  u32 version  u32 n_cols  u64 n_rows
+//   per column: u8 type_code  u8 has_offsets  u64 data_len  u64 validity_len
+//               u64 offsets_len, then the three buffers back to back,
+//               each 8-byte aligned.
+// ---------------------------------------------------------------------------
+
+static const uint32_t kMagic = 0x54505542;  // "TPUB"
+static const uint32_t kVersion = 1;
+
+static inline uint64_t align8(uint64_t x) { return (x + 7) & ~uint64_t(7); }
+
+// Returns required buffer size for serialization.
+uint64_t batch_serialized_size(int32_t n_cols, const uint64_t* data_lens,
+                               const uint64_t* validity_lens,
+                               const uint64_t* offsets_lens) {
+  uint64_t total = 4 + 4 + 4 + 8;
+  for (int32_t i = 0; i < n_cols; i++) {
+    total += 1 + 1 + 8 + 8 + 8;
+    total = align8(total);
+    total += align8(data_lens[i]) + align8(validity_lens[i]) +
+             align8(offsets_lens[i]);
+  }
+  return total;
+}
+
+// Serialize column buffers into out (must be >= batch_serialized_size).
+// Returns bytes written, or 0 on error.
+uint64_t batch_serialize(int32_t n_cols, uint64_t n_rows,
+                         const uint8_t* type_codes,
+                         const uint8_t** data_bufs, const uint64_t* data_lens,
+                         const uint8_t** validity_bufs,
+                         const uint64_t* validity_lens,
+                         const uint8_t** offsets_bufs,
+                         const uint64_t* offsets_lens, uint8_t* out,
+                         uint64_t out_cap) {
+  uint64_t need = batch_serialized_size(n_cols, data_lens, validity_lens,
+                                        offsets_lens);
+  if (out_cap < need) return 0;
+  uint64_t p = 0;
+  auto put32 = [&](uint32_t v) { std::memcpy(out + p, &v, 4); p += 4; };
+  auto put64 = [&](uint64_t v) { std::memcpy(out + p, &v, 8); p += 8; };
+  put32(kMagic);
+  put32(kVersion);
+  put32((uint32_t)n_cols);
+  put64(n_rows);
+  for (int32_t i = 0; i < n_cols; i++) {
+    out[p++] = type_codes[i];
+    out[p++] = offsets_lens[i] ? 1 : 0;
+    put64(data_lens[i]);
+    put64(validity_lens[i]);
+    put64(offsets_lens[i]);
+    p = align8(p);
+    std::memcpy(out + p, data_bufs[i], data_lens[i]);
+    p += align8(data_lens[i]);
+    std::memcpy(out + p, validity_bufs[i], validity_lens[i]);
+    p += align8(validity_lens[i]);
+    if (offsets_lens[i]) {
+      std::memcpy(out + p, offsets_bufs[i], offsets_lens[i]);
+      p += align8(offsets_lens[i]);
+    }
+  }
+  return p;
+}
+
+// Parse header: fills n_cols/n_rows; returns 0 on bad magic.
+int32_t batch_read_header(const uint8_t* buf, uint64_t len, int32_t* n_cols,
+                          uint64_t* n_rows) {
+  if (len < 20) return 0;
+  uint32_t magic, version;
+  std::memcpy(&magic, buf, 4);
+  std::memcpy(&version, buf + 4, 4);
+  if (magic != kMagic || version != kVersion) return 0;
+  uint32_t nc;
+  std::memcpy(&nc, buf + 8, 4);
+  *n_cols = (int32_t)nc;
+  std::memcpy(n_rows, buf + 12, 8);
+  return 1;
+}
+
+// Per-column metadata+pointer extraction. Arrays must hold n_cols entries.
+int32_t batch_deserialize_index(const uint8_t* buf, uint64_t len,
+                                uint8_t* type_codes, uint64_t* data_offs,
+                                uint64_t* data_lens, uint64_t* validity_offs,
+                                uint64_t* validity_lens,
+                                uint64_t* offsets_offs,
+                                uint64_t* offsets_lens) {
+  int32_t n_cols;
+  uint64_t n_rows;
+  if (!batch_read_header(buf, len, &n_cols, &n_rows)) return 0;
+  uint64_t p = 20;
+  for (int32_t i = 0; i < n_cols; i++) {
+    if (p + 26 > len) return 0;
+    type_codes[i] = buf[p++];
+    p++;  // has_offsets implied by offsets_lens
+    std::memcpy(&data_lens[i], buf + p, 8); p += 8;
+    std::memcpy(&validity_lens[i], buf + p, 8); p += 8;
+    std::memcpy(&offsets_lens[i], buf + p, 8); p += 8;
+    p = align8(p);
+    data_offs[i] = p;
+    p += align8(data_lens[i]);
+    validity_offs[i] = p;
+    p += align8(validity_lens[i]);
+    offsets_offs[i] = offsets_lens[i] ? p : 0;
+    p += align8(offsets_lens[i]);
+    if (p > len) return 0;
+  }
+  return 1;
+}
+
+// ---------------------------------------------------------------------------
+// Aligned host arena: power-of-two size-class recycling allocator.
+// ---------------------------------------------------------------------------
+
+struct Arena {
+  std::mutex mu;
+  std::map<uint64_t, std::vector<void*>> free_lists;  // size -> buffers
+  uint64_t allocated = 0;   // live bytes handed out
+  uint64_t pooled = 0;      // bytes sitting in free lists
+  uint64_t high_water = 0;
+  uint64_t pool_limit;
+  explicit Arena(uint64_t limit) : pool_limit(limit) {}
+};
+
+static uint64_t next_pow2(uint64_t v) {
+  if (v < 64) return 64;
+  v--;
+  v |= v >> 1; v |= v >> 2; v |= v >> 4;
+  v |= v >> 8; v |= v >> 16; v |= v >> 32;
+  return v + 1;
+}
+
+void* arena_create(uint64_t pool_limit_bytes) {
+  return new Arena(pool_limit_bytes);
+}
+
+void arena_destroy(void* arena) {
+  Arena* a = (Arena*)arena;
+  for (auto& kv : a->free_lists)
+    for (void* p : kv.second) std::free(p);
+  delete a;
+}
+
+void* arena_alloc(void* arena, uint64_t size) {
+  Arena* a = (Arena*)arena;
+  uint64_t cls = next_pow2(size);
+  {
+    std::lock_guard<std::mutex> g(a->mu);
+    auto it = a->free_lists.find(cls);
+    if (it != a->free_lists.end() && !it->second.empty()) {
+      void* p = it->second.back();
+      it->second.pop_back();
+      a->pooled -= cls;
+      a->allocated += cls;
+      if (a->allocated > a->high_water) a->high_water = a->allocated;
+      return p;
+    }
+  }
+  void* p = nullptr;
+  if (posix_memalign(&p, 64, cls) != 0) return nullptr;
+  std::lock_guard<std::mutex> g(a->mu);
+  a->allocated += cls;
+  if (a->allocated > a->high_water) a->high_water = a->allocated;
+  return p;
+}
+
+void arena_free(void* arena, void* ptr, uint64_t size) {
+  Arena* a = (Arena*)arena;
+  uint64_t cls = next_pow2(size);
+  std::lock_guard<std::mutex> g(a->mu);
+  a->allocated -= cls;
+  if (a->pooled + cls <= a->pool_limit) {
+    a->free_lists[cls].push_back(ptr);
+    a->pooled += cls;
+  } else {
+    std::free(ptr);
+  }
+}
+
+void arena_stats(void* arena, uint64_t* allocated, uint64_t* pooled,
+                 uint64_t* high_water) {
+  Arena* a = (Arena*)arena;
+  std::lock_guard<std::mutex> g(a->mu);
+  *allocated = a->allocated;
+  *pooled = a->pooled;
+  *high_water = a->high_water;
+}
+
+// ---------------------------------------------------------------------------
+// murmur3_x86_32, Spark layout (seed chains across columns; NULLs skipped).
+// Matches exprs/hashing.py word decomposition.
+// ---------------------------------------------------------------------------
+
+static inline uint32_t rotl32(uint32_t x, int8_t r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+static inline uint32_t mix_k1(uint32_t k1) {
+  k1 *= 0xCC9E2D51u;
+  k1 = rotl32(k1, 15);
+  return k1 * 0x1B873593u;
+}
+
+static inline uint32_t mix_h1(uint32_t h1, uint32_t k1) {
+  h1 ^= k1;
+  h1 = rotl32(h1, 13);
+  return h1 * 5 + 0xE6546B64u;
+}
+
+static inline uint32_t fmix(uint32_t h, uint32_t length) {
+  h ^= length;
+  h ^= h >> 16;
+  h *= 0x85EBCA6Bu;
+  h ^= h >> 13;
+  h *= 0xC2B2AE35u;
+  return h ^ (h >> 16);
+}
+
+// words: int64 per row per word column (pre-decomposed by python);
+// this hashes one column's words into running hashes h[n].
+// word_count in {1, 2}; length = 4 or 8; validity may be null (all valid).
+void murmur3_column(const uint32_t* words0, const uint32_t* words1,
+                    int32_t word_count, uint32_t byte_length,
+                    const uint8_t* validity, int64_t n, uint32_t* h) {
+  for (int64_t i = 0; i < n; i++) {
+    if (validity && !validity[i]) continue;
+    uint32_t hv = h[i];
+    hv = mix_h1(hv, mix_k1(words0[i]));
+    if (word_count > 1) hv = mix_h1(hv, mix_k1(words1[i]));
+    h[i] = fmix(hv, byte_length);
+  }
+}
+
+// pmod partition ids from final hashes.
+void pmod_partition(const uint32_t* h, int64_t n, int32_t n_parts,
+                    int32_t* out) {
+  for (int64_t i = 0; i < n; i++) {
+    int32_t v = (int32_t)h[i] % n_parts;
+    out[i] = v < 0 ? v + n_parts : v;
+  }
+}
+
+}  // extern "C"

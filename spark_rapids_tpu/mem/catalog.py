@@ -79,29 +79,36 @@ class SpillableBatch:
         self.tier = self.TIER_HOST
 
     def _spill_to_disk(self, directory: str):
+        """Disk tier: one file per batch in the engine's native frame format
+        (native_rt serializer = JCudfSerialization analogue) run through the
+        configured compression codec (TableCompressionCodec analogue)."""
         assert self.tier == self.TIER_HOST
-        path = os.path.join(directory, f"spill-{self.batch_id}.npz")
-        arrays = {}
-        for i, c in enumerate(self._host.columns):
-            if c.dtype.is_string:
-                arrays[f"v{i}"] = np.array(
-                    ["" if x is None else str(x) for x in c.to_list()],
-                    dtype=object)
-            else:
-                arrays[f"v{i}"] = c.values
-            arrays[f"m{i}"] = c.validity
-        np.savez(path, **arrays)
+        import struct
+
+        from spark_rapids_tpu.mem.codec import get_codec
+        from spark_rapids_tpu.native_rt import serialize_host_batch
+        codec = get_codec(self._catalog.spill_codec)
+        raw = serialize_host_batch(self._host)
+        enc = codec.compress(raw)
+        path = os.path.join(directory, f"spill-{self.batch_id}.tpub")
+        with open(path, "wb") as f:
+            f.write(struct.pack("<Q", len(raw)))
+            f.write(enc)
         self._disk_path = path
         self._host = None
         self.tier = self.TIER_DISK
 
     def _read_disk(self) -> HostBatch:
-        from spark_rapids_tpu.batch import HostColumn
-        data = np.load(self._disk_path, allow_pickle=True)
-        cols = []
-        for i, f in enumerate(self._schema.fields):
-            cols.append(HostColumn(f.dtype, data[f"v{i}"], data[f"m{i}"]))
-        return HostBatch(self._schema, cols)
+        import struct
+
+        from spark_rapids_tpu.mem.codec import get_codec
+        from spark_rapids_tpu.native_rt import deserialize_host_batch
+        codec = get_codec(self._catalog.spill_codec)
+        with open(self._disk_path, "rb") as f:
+            (raw_len,) = struct.unpack("<Q", f.read(8))
+            enc = f.read()
+        raw = codec.decompress(enc, raw_len)
+        return deserialize_host_batch(raw, self._schema)
 
     def host_bytes(self) -> int:
         if self._host is None:
@@ -156,6 +163,8 @@ class BufferCatalog:
         self.conf = conf
         self.device_budget = DEVICE_SPILL_BUDGET.get(conf)
         self.host_budget = conf.host_spill_storage_size
+        self.spill_codec = conf.get(
+            "spark.rapids.shuffle.compression.codec", "copy") or "copy"
         self._handles: Dict[int, SpillableBatch] = {}
         self._next_id = 0
         self._lock = threading.RLock()
