@@ -1,0 +1,2 @@
+"""Benchmark workloads and harness (reference: integration_tests
+TpchLikeSpark / TpcdsLikeSpark / BenchUtils — SURVEY.md section 4.5)."""

@@ -1,0 +1,44 @@
+"""Benchmark runner (BenchUtils.runBench analogue,
+integration_tests/BenchUtils.scala:109-240): runs queries with warmup +
+timed iterations, captures environment + conf, writes a JSON report."""
+
+from __future__ import annotations
+
+import json
+import platform
+import time
+from typing import Callable, Dict, List, Optional
+
+import jax
+
+
+def run_bench(session, name: str, query_fn: Callable[[], object],
+              iterations: int = 3, warmups: int = 1,
+              report_path: Optional[str] = None) -> Dict:
+    """query_fn() -> DataFrame; collects it warmups+iterations times."""
+    times: List[float] = []
+    rows = 0
+    for _ in range(warmups):
+        rows = len(query_fn().collect())
+    for _ in range(iterations):
+        t0 = time.monotonic()
+        rows = len(query_fn().collect())
+        times.append(time.monotonic() - t0)
+    report = {
+        "benchmark": name,
+        "iterations": iterations,
+        "times_s": [round(t, 4) for t in times],
+        "best_s": round(min(times), 4),
+        "mean_s": round(sum(times) / len(times), 4),
+        "result_rows": rows,
+        "env": {
+            "platform": platform.platform(),
+            "devices": [str(d) for d in jax.devices()],
+        },
+        "conf": {k: v for k, v in getattr(
+            session.conf, "_settings", {}).items()},
+    }
+    if report_path:
+        with open(report_path, "w") as f:
+            json.dump(report, f, indent=2)
+    return report

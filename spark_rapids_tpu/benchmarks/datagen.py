@@ -1,0 +1,108 @@
+"""Synthetic TPC-H-shaped data generator (the data_gen.py / TpchLikeSpark
+setup analogue).  Scale: rows = int(SF * base_rows); deterministic per seed.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+
+SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD", "MACHINERY"]
+PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
+FLAGS = ["A", "N", "R"]
+STATUSES = ["F", "O", "P"]
+MODES = ["AIR", "FOB", "MAIL", "RAIL", "REG AIR", "SHIP", "TRUCK"]
+NATIONS = ["ALGERIA", "BRAZIL", "CANADA", "EGYPT", "FRANCE", "GERMANY",
+           "INDIA", "JAPAN", "KENYA", "PERU", "CHINA", "ROMANIA"]
+
+_EPOCH_1992 = 8035   # days 1970->1992-01-01
+_EPOCH_1999 = 10592  # days 1970->1998-12-31
+
+
+def gen_lineitem(sf: float, seed: int = 11) -> Dict:
+    n = max(1, int(sf * 60_000))
+    r = np.random.RandomState(seed)
+    qty = r.randint(1, 51, n)
+    price = (r.rand(n) * 90000 + 900).round(2)
+    disc = (r.randint(0, 11, n) / 100.0)
+    tax = (r.randint(0, 9, n) / 100.0)
+    return {
+        "l_orderkey": (T.LONG, r.randint(1, int(sf * 15_000) + 2, n)),
+        "l_partkey": (T.LONG, r.randint(1, int(sf * 2_000) + 2, n)),
+        "l_suppkey": (T.LONG, r.randint(1, int(sf * 100) + 2, n)),
+        "l_quantity": (T.DOUBLE, qty.astype(np.float64)),
+        "l_extendedprice": (T.DOUBLE, price),
+        "l_discount": (T.DOUBLE, disc),
+        "l_tax": (T.DOUBLE, tax),
+        "l_returnflag": (T.STRING, r.choice(FLAGS, n)),
+        "l_linestatus": (T.STRING, r.choice(STATUSES, n)),
+        "l_shipdate": (T.DATE,
+                       r.randint(_EPOCH_1992, _EPOCH_1999, n)),
+        "l_commitdate": (T.DATE,
+                         r.randint(_EPOCH_1992, _EPOCH_1999, n)),
+        "l_receiptdate": (T.DATE,
+                          r.randint(_EPOCH_1992, _EPOCH_1999, n)),
+        "l_shipmode": (T.STRING, r.choice(MODES, n)),
+    }
+
+
+def gen_orders(sf: float, seed: int = 12) -> Dict:
+    n = max(1, int(sf * 15_000))
+    r = np.random.RandomState(seed)
+    return {
+        "o_orderkey": (T.LONG, np.arange(1, n + 1)),
+        "o_custkey": (T.LONG, r.randint(1, int(sf * 1_500) + 2, n)),
+        "o_orderstatus": (T.STRING, r.choice(STATUSES, n)),
+        "o_totalprice": (T.DOUBLE, (r.rand(n) * 500000).round(2)),
+        "o_orderdate": (T.DATE, r.randint(_EPOCH_1992, _EPOCH_1999, n)),
+        "o_orderpriority": (T.STRING, r.choice(PRIORITIES, n)),
+        "o_shippriority": (T.INT, np.zeros(n, dtype=np.int32)),
+    }
+
+
+def gen_customer(sf: float, seed: int = 13) -> Dict:
+    n = max(1, int(sf * 1_500))
+    r = np.random.RandomState(seed)
+    return {
+        "c_custkey": (T.LONG, np.arange(1, n + 1)),
+        "c_name": (T.STRING,
+                   [f"Customer#{i:09d}" for i in range(1, n + 1)]),
+        "c_nationkey": (T.INT, r.randint(0, len(NATIONS), n)),
+        "c_mktsegment": (T.STRING, r.choice(SEGMENTS, n)),
+        "c_acctbal": (T.DOUBLE, (r.rand(n) * 10000 - 1000).round(2)),
+    }
+
+
+def gen_supplier(sf: float, seed: int = 14) -> Dict:
+    n = max(1, int(sf * 100))
+    r = np.random.RandomState(seed)
+    return {
+        "s_suppkey": (T.LONG, np.arange(1, n + 1)),
+        "s_name": (T.STRING, [f"Supplier#{i:09d}" for i in range(1, n + 1)]),
+        "s_nationkey": (T.INT, r.randint(0, len(NATIONS), n)),
+    }
+
+
+def gen_nation() -> Dict:
+    n = len(NATIONS)
+    return {
+        "n_nationkey": (T.INT, np.arange(n, dtype=np.int32)),
+        "n_name": (T.STRING, list(NATIONS)),
+        "n_regionkey": (T.INT, (np.arange(n) % 5).astype(np.int32)),
+    }
+
+
+def register_tpch(session, sf: float = 0.01, num_partitions: int = 4):
+    """Create and register all TPC-H-like tables as temp views."""
+    for name, data in [
+        ("lineitem", gen_lineitem(sf)),
+        ("orders", gen_orders(sf)),
+        ("customer", gen_customer(sf)),
+        ("supplier", gen_supplier(sf)),
+        ("nation", gen_nation()),
+    ]:
+        df = session.create_dataframe(data, num_partitions=num_partitions)
+        df.create_or_replace_temp_view(name)

@@ -183,6 +183,18 @@ def promote(a: DataType, b: DataType) -> DataType:
         # long + float -> double to avoid precision loss (Spark behavior is
         # float, but double is the safe superset; we follow Spark: wider wins).
         return _NUMERIC_ORDER[max(ia, ib)]
+    # date/timestamp compare+arithmetic against their integral carriers
+    # (date = int32 days, timestamp = int64 micros)
+    if a.is_datetime or b.is_datetime:
+        def norm(t: DataType) -> DataType:
+            if isinstance(t, DateType):
+                return INT
+            if isinstance(t, TimestampType):
+                return LONG
+            return t
+        na, nb = norm(a), norm(b)
+        if na.is_numeric and nb.is_numeric:
+            return promote(na, nb)
     raise TypeError(f"no common type for {a} and {b}")
 
 

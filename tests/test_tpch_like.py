@@ -1,0 +1,34 @@
+"""TPC-H-like query correctness: every query runs on the TPU engine and the
+CPU engine and must agree (TpchLikeSparkSuite analogue)."""
+
+import pytest
+
+from spark_rapids_tpu.benchmarks.datagen import register_tpch
+from spark_rapids_tpu.benchmarks.tpch_like import QUERIES
+
+from compare import assert_tpu_cpu_equal
+
+SF = 0.02
+
+
+@pytest.mark.parametrize("qname", sorted(QUERIES.keys()))
+def test_tpch_like_query(qname):
+    def build(s):
+        register_tpch(s, sf=SF, num_partitions=3)
+        return s.sql(QUERIES[qname])
+    ordered = "ORDER BY" in QUERIES[qname].upper()
+    assert_tpu_cpu_equal(build, approx=True, ignore_order=not ordered)
+
+
+def test_bench_utils_report(tmp_path):
+    from compare import tpu_session
+    from spark_rapids_tpu.benchmarks.bench_utils import run_bench
+    s = tpu_session()
+    register_tpch(s, sf=0.005, num_partitions=2)
+    path = str(tmp_path / "report.json")
+    rep = run_bench(s, "q6", lambda: s.sql(QUERIES["q6"]),
+                    iterations=1, warmups=0, report_path=path)
+    assert rep["result_rows"] >= 1
+    import json
+    with open(path) as f:
+        assert json.load(f)["benchmark"] == "q6"
