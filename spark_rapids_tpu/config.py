@@ -223,6 +223,10 @@ REPLACE_SORT_MERGE_JOIN = conf_bool(
     "spark.rapids.sql.replaceSortMergeJoin.enabled", True,
     "Replace sort-merge joins with TPU hash joins and drop the now "
     "unneeded sorts (reference: RapidsConf.scala:423).")
+AUTO_BROADCAST_THRESHOLD = conf_bytes(
+    "spark.sql.autoBroadcastJoinThreshold", 10 << 20,
+    "Max estimated build-side bytes for choosing a broadcast hash join "
+    "over a shuffled hash join; -1 disables broadcast.")
 SHUFFLE_PARTITIONS = conf_int(
     "spark.sql.shuffle.partitions", 8,
     "Number of partitions used for shuffle exchanges.")

@@ -228,6 +228,14 @@ def rand(seed: int = 42) -> Column:
     return Column(Rand(seed))
 
 
+def broadcast(df):
+    """Hint: prefer broadcasting this side of a join
+    (GpuBroadcastExchangeExec path)."""
+    from spark_rapids_tpu.dataframe import DataFrame
+    from spark_rapids_tpu.plan import logical as L
+    return DataFrame(L.BroadcastHint(df.plan), df.session)
+
+
 # -- python UDFs -------------------------------------------------------------
 
 

@@ -124,13 +124,13 @@ class TpuProjectExec(TpuExec):
         super().__init__([child], schema)
         self.exprs = exprs
 
-        @jax.jit
         def run(batch: ColumnBatch) -> ColumnBatch:
             ctx = TpuEvalCtx(batch)
             cols = [e.tpu_eval(ctx).to_column() for e in self.exprs]
             return ColumnBatch(schema, cols, batch.num_rows, batch.capacity)
 
-        self._run = run
+        self.batch_fn = run
+        self._run = jax.jit(run)
 
     def describe(self):
         return f"TpuProject({', '.join(f.name for f in self.output_schema)})"
@@ -145,14 +145,14 @@ class TpuFilterExec(TpuExec):
         super().__init__([child], child.output_schema)
         self.condition = condition
 
-        @jax.jit
         def run(batch: ColumnBatch) -> ColumnBatch:
             ctx = TpuEvalCtx(batch)
             v = self.condition.tpu_eval(ctx)
             keep = v.validity & v.data.astype(jnp.bool_)
             return compact(batch, keep)
 
-        self._run = run
+        self.batch_fn = run
+        self._run = jax.jit(run)
 
     def describe(self):
         return f"TpuFilter({self.condition!r})"
@@ -213,6 +213,35 @@ class TpuCoalesceBatchesExec(TpuExec):
         return [gen(p) for p in self.children[0].partitions(ctx)]
 
 
+class TpuFusedMapExec(TpuExec):
+    """A chain of map-like stages (project/filter) compiled as ONE XLA
+    program per batch.  Collapsing dispatch count matters doubly on TPU:
+    host->device dispatch latency amortizes, and XLA fuses the whole chain
+    into a single HBM pass (the role GpuCoalesceBatches + JIT fusion play
+    for the reference's per-op cudf calls)."""
+
+    def __init__(self, child: PhysicalOp, fns, schema: T.Schema,
+                 labels: List[str]):
+        super().__init__([child], schema)
+        self.fns = list(fns)
+        self.labels = labels
+
+        def composed(batch: ColumnBatch) -> ColumnBatch:
+            for f in self.fns:
+                batch = f(batch)
+            return batch
+
+        self.batch_fn = composed
+        self._run = jax.jit(composed)
+
+    def describe(self):
+        return f"TpuFusedMap({' -> '.join(self.labels)})"
+
+    def partitions(self, ctx):
+        return [map(self._run, p)
+                for p in self.children[0].partitions(ctx)]
+
+
 class TpuLocalLimitExec(TpuExec):
     def __init__(self, n: int, child: PhysicalOp):
         super().__init__([child], child.output_schema)
@@ -243,16 +272,23 @@ class TpuSortExec(TpuExec):
         super().__init__([child], child.output_schema)
         self.orders = orders
         self.key_exprs = key_exprs
+        self._input_fns = []
 
-        @jax.jit
         def run(batch: ColumnBatch) -> ColumnBatch:
+            for f in self._input_fns:
+                batch = f(batch)
             ctx = TpuEvalCtx(batch)
             vals = [e.tpu_eval(ctx) for e in self.key_exprs]
             return sort_batch(batch, vals,
                               [o.ascending for o in self.orders],
                               [o.nulls_first for o in self.orders])
 
-        self._run = run
+        self._run = jax.jit(run)
+
+    def absorb_input(self, fns):
+        # project/filter commute with concat (row-wise / stable), so fused
+        # stages run once on the merged batch
+        self._input_fns = list(fns)
 
     def describe(self):
         return f"TpuSort({len(self.orders)} keys)"
@@ -308,6 +344,21 @@ class TpuHashAggregateExec(TpuExec):
 
         self._run = run
         self._merge_run = jax.jit(self._merge_partials)
+        self._input_fns = []
+
+    def absorb_input(self, fns):
+        """Fuse upstream map-like stages (project/filter) into this exec's
+        per-batch compiled program — one XLA dispatch instead of N
+        (critical when dispatch latency is high; also lets XLA fuse
+        elementwise work into the aggregation's sort pass)."""
+        self._input_fns = list(fns)
+
+        def run(batch: ColumnBatch) -> ColumnBatch:
+            for f in self._input_fns:
+                batch = f(batch)
+            return self._aggregate_batch(batch)
+
+        self._run = jax.jit(run)
 
     def describe(self):
         return f"TpuHashAggregate({self.mode}, keys={len(self.key_exprs)})"
@@ -396,8 +447,7 @@ class TpuHashAggregateExec(TpuExec):
             # concatenateBatches + merge-aggregate loop,
             # aggregate.scala:434-492).
             def gen(part):
-                partials = [shrink_to_fit(self._run(db)) for db in part
-                            if db.host_num_rows()]
+                partials = [shrink_to_fit(self._run(db)) for db in part]
                 if not partials:
                     return
                 if len(partials) == 1:
@@ -626,3 +676,72 @@ class TpuCachedScanExec(TpuExec):
                 yield h.get()
 
         return [gen(p) for p in self.holder.partitions]
+
+
+class TpuBroadcastHashJoinExec(TpuExec):
+    """Hash join against a broadcast build side: the build side is
+    materialized ONCE (all partitions concatenated on device) and every
+    stream partition joins against it — no shuffle on either side
+    (GpuBroadcastHashJoinExec analogue, shims/spark300).
+
+    ``broadcast_side`` is "right" or "left".  Planner guarantees the join
+    type is legal for the broadcast side (no broadcast of the outer side's
+    opposite: right broadcast for inner/left/semi/anti, left broadcast for
+    inner/right)."""
+
+    def __init__(self, stream: PhysicalOp, broadcast: PhysicalOp,
+                 left_keys: List[Expression], right_keys: List[Expression],
+                 how: str, broadcast_side: str,
+                 condition: Optional[Expression], schema: T.Schema):
+        super().__init__([stream, broadcast], schema)
+        self.left_keys = left_keys
+        self.right_keys = right_keys
+        self.how = how
+        self.broadcast_side = broadcast_side
+        self.condition = condition
+        self._bc: Optional[ColumnBatch] = None
+
+    def describe(self):
+        return f"TpuBroadcastHashJoin({self.how}, bc={self.broadcast_side})"
+
+    def num_partitions(self, ctx):
+        return self.children[0].num_partitions(ctx)
+
+    def _broadcast_batch(self, ctx) -> Optional[ColumnBatch]:
+        if self._bc is None:
+            batches = []
+            for p in self.children[1].partitions(ctx):
+                batches.extend(p)
+            self._bc = _concat_all(batches,
+                                   self.children[1].output_schema)
+        return self._bc
+
+    def partitions(self, ctx):
+        bc = self._broadcast_batch(ctx)
+        bc_schema = self.children[1].output_schema
+        stream_schema = self.children[0].output_schema
+
+        def gen(part):
+            nonlocal bc
+            for sb in part:
+                if bc is None:
+                    bc_local = empty_device_batch(bc_schema)
+                else:
+                    bc_local = bc
+                if self.broadcast_side == "right":
+                    lb, rb = sb, bc_local
+                else:
+                    lb, rb = bc_local, sb
+                lctx = TpuEvalCtx(lb)
+                rctx = TpuEvalCtx(rb)
+                lkeys = [e.tpu_eval(lctx) for e in self.left_keys]
+                rkeys = [e.tpu_eval(rctx) for e in self.right_keys]
+                out = hash_join(lb, lkeys, rb, rkeys, self.how,
+                                self.output_schema)
+                if self.condition is not None:
+                    cctx = TpuEvalCtx(out)
+                    v = self.condition.tpu_eval(cctx)
+                    out = compact(out, v.validity & v.data.astype(jnp.bool_))
+                yield out
+
+        return [gen(p) for p in self.children[0].partitions(ctx)]

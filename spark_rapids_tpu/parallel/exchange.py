@@ -87,8 +87,14 @@ class TpuShuffleExchangeExec(TpuExec):
     def __init__(self, partitioning: Partitioning, child: PhysicalOp):
         super().__init__([child], child.output_schema)
         self.partitioning = partitioning
+        self._input_fns = []
         self._sort_by_pid = jax.jit(self._sort_by_pid_impl,
                                     static_argnames=("n",))
+
+    def absorb_input(self, fns):
+        """Fuse upstream map-like stages into the partition-split program
+        (one dispatch per batch for filter+project+hash+sort-by-pid)."""
+        self._input_fns = list(fns)
 
     def describe(self):
         p = self.partitioning
@@ -102,6 +108,8 @@ class TpuShuffleExchangeExec(TpuExec):
         contiguous (the GPU `Table.partition` + contiguousSplit shape,
         GpuPartitioning.scala:44-117).  Returns (sorted batch, per-target
         row counts, per-target byte totals for each string column)."""
+        for f in self._input_fns:
+            batch = f(batch)
         cap = batch.capacity
         ids = self.partitioning.device_partition_ids(batch, part_index)
         live = jnp.arange(cap, dtype=jnp.int32) < batch.num_rows

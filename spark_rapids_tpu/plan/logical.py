@@ -362,3 +362,14 @@ class CachedRelation(LogicalPlan):
     def describe(self):
         state = "materialized" if self.holder.is_materialized else "lazy"
         return f"CachedRelation({state})"
+
+
+class BroadcastHint(LogicalPlan):
+    """Marks a subtree as broadcast-preferred (functions.broadcast(df))."""
+
+    def __init__(self, child: LogicalPlan):
+        self.children = (child,)
+
+    @property
+    def schema(self):
+        return self.children[0].schema

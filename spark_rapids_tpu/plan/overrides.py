@@ -183,7 +183,11 @@ class TpuOverrides:
         if self.conf.explain_enabled:
             print(self.last_explain)
         phys = self._convert(meta)
-        return _insert_transitions(phys)
+        phys = _insert_transitions(phys)
+        if self.conf.get("spark.rapids.sql.fusion.enabled", True) \
+                not in (False, "false"):
+            phys = _fuse_map_chains(phys)
+        return phys
 
     def _shuffle_parts(self) -> int:
         return self.conf.shuffle_partitions
@@ -199,7 +203,11 @@ class TpuOverrides:
         if isinstance(node, L.FileScan):
             from spark_rapids_tpu.io.scan import CpuFileScanExec
             return CpuFileScanExec(node, self.conf)
+        if isinstance(node, L.BroadcastHint):
+            return conv[0]
         if isinstance(node, L.CachedRelation):
+            if not self.conf.sql_enabled:
+                return conv[0]  # CPU engine: no device cache
             return X.TpuCachedScanExec(
                 node.holder,
                 None if node.holder.is_materialized else
@@ -366,6 +374,35 @@ class TpuOverrides:
                                  _to_device(child))
         return C.CpuSortExec(orders, key_ordinals, _to_host(child))
 
+    def _estimate_size(self, node: L.LogicalPlan):
+        """Rough plan-output byte estimate for broadcast decisions (the
+        role Spark statistics play for GpuBroadcastHashJoinExec planning)."""
+        if isinstance(node, L.BroadcastHint):
+            return 0
+        if isinstance(node, L.InMemoryScan):
+            total = 0
+            for hb in node.batches:
+                for f, c in zip(hb.schema.fields, hb.columns):
+                    if f.dtype.is_string:
+                        total += sum(len(str(x)) for x in c.values) + \
+                            4 * len(c.values)
+                    else:
+                        total += c.values.nbytes
+            return total
+        if isinstance(node, L.Range):
+            total = max(0, -(-(node.end - node.start) // node.step))
+            return total * 8
+        if isinstance(node, L.FileScan):
+            import os
+            try:
+                return sum(os.path.getsize(p) for p in node.paths)
+            except OSError:
+                return None
+        if isinstance(node, (L.Project, L.Filter, L.Limit, L.Sample,
+                             L.Distinct, L.Sort, L.CachedRelation)):
+            return self._estimate_size(node.children[0])
+        return None
+
     def _convert_join(self, node: L.Join, conv: List[PhysicalOp],
                       on_tpu: bool) -> PhysicalOp:
         left, right = conv
@@ -377,6 +414,29 @@ class TpuOverrides:
             return C.CpuNestedLoopJoinExec(
                 _to_host(left), _to_host(right), node.how, node.condition,
                 node.schema)
+        if on_tpu:
+            threshold = int(self.conf.get(
+                "spark.sql.autoBroadcastJoinThreshold", 10 * 1024 * 1024))
+            l_est = self._estimate_size(node.children[0])
+            r_est = self._estimate_size(node.children[1])
+            bc_side = None
+            if node.how in ("inner", "left", "left_semi", "left_anti") and \
+                    r_est is not None and r_est <= threshold:
+                bc_side = "right"
+            if node.how in ("inner", "right") and l_est is not None and \
+                    l_est <= threshold and (
+                        bc_side is None or (r_est is None or l_est < r_est)):
+                bc_side = "left"
+            if bc_side == "right":
+                return X.TpuBroadcastHashJoinExec(
+                    _to_device(left), _to_device(right), node.left_keys,
+                    node.right_keys, node.how, "right", node.condition,
+                    node.schema)
+            if bc_side == "left":
+                return X.TpuBroadcastHashJoinExec(
+                    _to_device(right), _to_device(left), node.left_keys,
+                    node.right_keys, node.how, "left", node.condition,
+                    node.schema)
         n_parts = self._shuffle_parts()
         lpart = HashPartitioning(node.left_keys, n_parts)
         rpart = HashPartitioning(node.right_keys, n_parts)
@@ -440,6 +500,51 @@ def _compile_plan_udfs(plan: L.LogicalPlan) -> L.LogicalPlan:
     # other nodes: rebuild children in place
     plan.children = tuple(new_children)
     return plan
+
+
+def _is_map_like(op: PhysicalOp) -> bool:
+    return isinstance(op, (X.TpuProjectExec, X.TpuFilterExec,
+                           X.TpuFusedMapExec)) and len(op.children) == 1
+
+
+def _map_fns(op: PhysicalOp):
+    if isinstance(op, X.TpuFusedMapExec):
+        return op.fns, op.labels
+    return [op.batch_fn], [op.name]
+
+
+def _fuse_map_chains(op: PhysicalOp) -> PhysicalOp:
+    """Dispatch-count optimizer: collapse chains of per-batch map ops into
+    one compiled program, and absorb map chains into the per-batch programs
+    of aggregation/sort/exchange consumers.  One XLA dispatch then covers
+    e.g. filter+project+partial-aggregate — XLA fuses the elementwise work
+    into the aggregation's sort pass, and host->device dispatch latency is
+    paid once per batch instead of once per operator."""
+    from spark_rapids_tpu.parallel.partitioning import (
+        HashPartitioning, RoundRobinPartitioning,
+    )
+    op.children = [_fuse_map_chains(c) for c in op.children]
+
+    if _is_map_like(op) and op.children and _is_map_like(op.children[0]):
+        child = op.children[0]
+        cf, cl = _map_fns(child)
+        of, ol = _map_fns(op)
+        return X.TpuFusedMapExec(child.children[0], cf + of,
+                                 op.output_schema, cl + ol)
+
+    absorb_ok = (
+        (isinstance(op, X.TpuHashAggregateExec) and op.mode == "update") or
+        isinstance(op, X.TpuSortExec) or
+        (isinstance(op, TpuShuffleExchangeExec) and
+         isinstance(op.partitioning,
+                    (HashPartitioning, RoundRobinPartitioning)))
+    )
+    if absorb_ok and op.children and _is_map_like(op.children[0]):
+        child = op.children[0]
+        fns, _ = _map_fns(child)
+        op.absorb_input(fns)
+        op.children = [child.children[0]]
+    return op
 
 
 def _to_device(op: PhysicalOp) -> PhysicalOp:

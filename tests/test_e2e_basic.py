@@ -137,9 +137,10 @@ def test_join_inner():
     assert_tpu_cpu_equal(q)
 
 
+@pytest.mark.parametrize("bc", ["broadcast", "shuffle"])
 @pytest.mark.parametrize("how", ["left", "right", "full", "left_semi",
                                  "left_anti"])
-def test_join_types(how):
+def test_join_types(how, bc):
     other = {
         "a": (T.INT, [2, 3, 5, 5, 8, None]),
         "v": (T.STRING, ["x", "y", "z", "w", "q", "n"]),
@@ -149,7 +150,20 @@ def test_join_types(how):
         df = make_df(s)
         d2 = s.create_dataframe(other, num_partitions=2)
         return df.join(d2, on="a", how=how)
-    assert_tpu_cpu_equal(q)
+    confs = {} if bc == "broadcast" else \
+        {"spark.sql.autoBroadcastJoinThreshold": -1}
+    assert_tpu_cpu_equal(q, confs=confs)
+
+
+def test_broadcast_hint_forces_broadcast_plan():
+    from spark_rapids_tpu import functions as F
+    s = tpu_session()
+    df = make_df(s)
+    d2 = s.create_dataframe({"a": (T.INT, [1, 2]),
+                             "w": (T.INT, [10, 20])})
+    out = df.join(F.broadcast(d2), on="a", how="inner")
+    out.collect()
+    assert "TpuBroadcastHashJoin" in s.last_physical_plan.tree_string()
 
 
 def test_join_multi_key_expr_cond():
