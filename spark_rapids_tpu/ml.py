@@ -1,0 +1,63 @@
+"""ML integration: zero-copy handoff of device-resident query results to JAX
+ML pipelines.
+
+Reference analogue: ColumnarRdd (ColumnarRdd.scala:41-49) exposes
+``RDD[cudf.Table]`` so XGBoost trains directly on GPU batches without a
+host round trip.  Here the query result stays as ``jax.Array`` columns in
+HBM, ready to feed jitted training steps (the dlpack story of SURVEY.md
+section 7 is unnecessary — both sides are already JAX).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.batch import ColumnBatch
+from spark_rapids_tpu.plan.overrides import TpuOverrides
+from spark_rapids_tpu.plan.physical import ExecContext, HostToDeviceExec
+
+
+def to_device_batches(df) -> List[ColumnBatch]:
+    """Execute the plan and return the per-partition device batches WITHOUT
+    copying to host (ColumnarRdd.convert analogue)."""
+    session = df.session
+    overrides = TpuOverrides(session.conf)
+    phys = overrides.apply(df.plan)
+    if not phys.is_tpu:
+        phys = HostToDeviceExec(phys)
+    ctx = ExecContext(
+        session.conf,
+        semaphore=session.runtime.semaphore if session.runtime else None,
+        device=session.runtime.device if session.runtime else None)
+    out: List[ColumnBatch] = []
+    for part in phys.partitions(ctx):
+        out.extend(part)
+    return out
+
+
+def to_jax(df, dense_only: bool = True) -> Dict[str, jnp.ndarray]:
+    """Execute and return {column: jnp.ndarray} of the LIVE rows, compacted
+    into one array per column — the feature-matrix handoff for training.
+
+    Strings are excluded when dense_only (encode them in the query with
+    hash()/cast first, the way the reference's XGBoost flow pre-encodes).
+    """
+    from spark_rapids_tpu.kernels.layout import gather_rows
+    from spark_rapids_tpu.ops.tpu_exec import _concat_all, shrink_to_fit
+    batches = to_device_batches(df)
+    if not batches:
+        return {f.name: jnp.zeros(0, dtype=f.dtype.jnp_dtype)
+                for f in df.schema.fields if not f.dtype.is_string}
+    merged = shrink_to_fit(_concat_all(batches, df.plan.schema))
+    n = merged.host_num_rows()
+    out: Dict[str, jnp.ndarray] = {}
+    for f, c in zip(merged.schema.fields, merged.columns):
+        if f.dtype.is_string:
+            if dense_only:
+                continue
+            raise ValueError("string columns need dense_only=False handling")
+        out[f.name] = c.data[:n]
+        out[f.name + "__valid"] = c.validity[:n]
+    return out

@@ -433,14 +433,40 @@ class TpuHashAggregateExec(TpuExec):
             # Inputs are partial-buffer batches (post-exchange): concat the
             # whole partition FIRST, then merge+finalize once.  Re-merging
             # finalized outputs would be wrong (avg, first/last...).
-            def gen(part):
-                merged = _concat_all(list(part), child_schema)
+            #
+            # AQE-style partition coalescing (GpuCustomShuffleReaderExec
+            # role): post-shuffle partitions are often tiny; group small
+            # ones so one compiled merge covers a worthwhile row count and
+            # downstream sees fewer partitions.
+            parts = [list(p) for p in self.children[0].partitions(ctx)]
+            if ctx.conf.get(
+                    "spark.rapids.sql.adaptive.coalescePartitions.enabled",
+                    True) not in (False, "false") and len(parts) > 1:
+                target = int(ctx.conf.get(
+                    "spark.rapids.sql.adaptive.targetPartitionRows",
+                    1 << 16))
+                sizes = [sum(b.host_num_rows() for b in p) for p in parts]
+                groups, cur, cur_rows = [], [], 0
+                for pp, sz in zip(parts, sizes):
+                    cur.extend(pp)
+                    cur_rows += sz
+                    if cur_rows >= target:
+                        groups.append(cur)
+                        cur, cur_rows = [], 0
+                if cur or not groups:
+                    groups.append(cur)
+                parts = groups
+
+            def gen(batches):
+                merged = _concat_all(batches, child_schema)
                 if merged is None:
                     if self.key_exprs:
                         return
                     # keyless reduction on empty input -> SQL default row
                     merged = empty_device_batch(child_schema)
                 yield shrink_to_fit(self._run(merged))
+
+            return [gen(p) for p in parts]
         else:
             # update mode: aggregate each batch, then combine this
             # partition's partials: concat + buffer-merge (the reference's

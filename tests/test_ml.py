@@ -1,0 +1,42 @@
+"""ML handoff tests (ColumnarRdd / InternalColumnarRddConverter analogue)."""
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.ml import to_device_batches, to_jax
+
+from compare import tpu_session
+
+DATA = {
+    "x": (T.DOUBLE, [1.0, 2.0, 3.0, 4.0, 5.0, 6.0]),
+    "y": (T.INT, [0, 1, 0, 1, 0, None]),
+    "s": (T.STRING, ["a", "b", "c", "d", "e", "f"]),
+}
+
+
+def test_to_device_batches():
+    s = tpu_session()
+    df = s.create_dataframe(DATA, num_partitions=2)
+    batches = to_device_batches(df.filter(df["x"] > 1.5))
+    assert batches
+    total = sum(b.host_num_rows() for b in batches)
+    assert total == 5
+    # results are device arrays, not host copies
+    assert isinstance(batches[0].columns[0].data, jnp.ndarray)
+
+
+def test_to_jax_feature_matrix():
+    s = tpu_session()
+    df = s.create_dataframe(DATA, num_partitions=2)
+    feats = to_jax(df.select("x", "y"))
+    assert set(feats) == {"x", "x__valid", "y", "y__valid"}
+    assert feats["x"].shape[0] == 6
+    assert int(feats["y__valid"].sum()) == 5
+    # feed straight into a jitted step (no host copy needed)
+    import jax
+
+    @jax.jit
+    def step(x, v):
+        return jnp.sum(jnp.where(v, x, 0.0))
+
+    assert float(step(feats["x"], feats["x__valid"])) == 21.0
