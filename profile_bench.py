@@ -1,0 +1,56 @@
+"""Stage-by-stage timing of the bench pipeline on the real device."""
+
+import time
+
+import numpy as np
+
+from bench import PARTS, ROWS, make_data
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.session import TpuSparkSession
+from spark_rapids_tpu import functions as F
+
+
+def t(label, fn, n=2):
+    fn()  # warmup
+    best = min(time.monotonic() - (time.monotonic() - 0) or 0 for _ in [0])
+    best = float("inf")
+    for _ in range(n):
+        t0 = time.monotonic()
+        fn()
+        best = min(best, time.monotonic() - t0)
+    print(f"{label:40s} {best*1000:9.1f} ms")
+    return best
+
+
+def main():
+    data = make_data(ROWS)
+    conf = RapidsConf({"spark.rapids.sql.enabled": True,
+                       "spark.sql.shuffle.partitions": PARTS})
+    s = TpuSparkSession(conf)
+    df = s.create_dataframe(data, num_partitions=PARTS).cache()
+
+    t0 = time.monotonic()
+    df.count()
+    print(f"{'cache materialize + count':40s} "
+          f"{(time.monotonic()-t0)*1000:9.1f} ms")
+
+    t("count (cached scan + keyless agg)", lambda: df.count())
+
+    filt = df.filter((df["ss_quantity"] < 25) &
+                     (df["ss_ext_discount_amt"] > 10.0))
+    t("filter + count", lambda: filt.count())
+
+    proj = filt.with_column(
+        "revenue", df["ss_sales_price"] * df["ss_ext_discount_amt"])
+    agg = proj.group_by("ss_item_sk").agg(
+        F.sum("revenue").alias("sum_rev"),
+        F.count("revenue").alias("cnt"),
+        F.avg("ss_sales_price").alias("avg_price"))
+    t("filter+proj+groupby agg collect", lambda: agg.collect())
+
+    full = agg.order_by("ss_item_sk")
+    t(".. + order_by collect", lambda: full.collect())
+
+
+if __name__ == "__main__":
+    main()

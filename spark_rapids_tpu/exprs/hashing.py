@@ -96,10 +96,8 @@ def murmur3_cols(vals: Sequence[DevVal], seed: int = 42):
     for v in vals:
         if v.dtype.is_string:
             from spark_rapids_tpu.exprs.strings import string_hash2
-            h1, _ = string_hash2(v)
-            lo = (h1 & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
-            hi = (h1 >> jnp.uint64(32)).astype(jnp.uint32)
-            words, length = [lo, hi], 8
+            h1, h2 = string_hash2(v)
+            words, length = [h1, h2], 8
         else:
             words, length = _words_of(v, jnp)
         hv = h
@@ -118,10 +116,9 @@ def murmur3_cols_cpu(vals: Sequence[CpuVal], seed: int = 42):
         for v in vals:
             if v.dtype.is_string:
                 from spark_rapids_tpu.exprs.strings import hash_literal2
-                h1 = np.array([hash_literal2(str(s))[0] for s in v.values],
-                              dtype=np.uint64)
-                lo = (h1 & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-                hi = (h1 >> np.uint64(32)).astype(np.uint32)
+                pairs = [hash_literal2(str(s)) for s in v.values]
+                lo = np.array([p[0] for p in pairs], dtype=np.uint32)
+                hi = np.array([p[1] for p in pairs], dtype=np.uint32)
                 words, length = [lo, hi], 8
             else:
                 words, length = _words_of(

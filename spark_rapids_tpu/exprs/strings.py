@@ -51,23 +51,27 @@ _HASH_BASES = (31, 131)
 
 
 def _pow_table(base: int, n: int):
+    # uint32 modular polynomial powers (wraps mod 2^32 — native on TPU;
+    # u64 arithmetic would be emulated)
     return jnp.concatenate([
-        jnp.ones(1, dtype=jnp.uint64),
-        jnp.cumprod(jnp.full(n, base, dtype=jnp.uint64)),
+        jnp.ones(1, dtype=jnp.uint32),
+        jnp.cumprod(jnp.full(n, base, dtype=jnp.uint32)),
     ])
 
 
 def string_hash2(v: DevVal) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Dual 64-bit polynomial row hashes: h = sum byte[i] * base^(end-1-i)."""
+    """Dual 32-bit polynomial row hashes: h = sum byte[i] * base^(end-1-i)
+    (mod 2^32).  Equality tests combine both hashes + length (+ the 64-byte
+    sort prefix where exactness matters)."""
     cap = v.capacity
     nbytes = int(v.data.shape[0])
     rows = rows_of_positions(v.offsets, nbytes)
     rows_c = jnp.clip(rows, 0, cap - 1)
-    ends = v.offsets[rows_c + 1].astype(jnp.int64)
-    pos = jnp.arange(nbytes, dtype=jnp.int64)
-    in_data = pos < v.offsets[-1].astype(jnp.int64)
+    ends = v.offsets[rows_c + 1].astype(jnp.int32)
+    pos = jnp.arange(nbytes, dtype=jnp.int32)
+    in_data = pos < v.offsets[-1].astype(jnp.int32)
     exp = jnp.clip(ends - 1 - pos, 0, nbytes).astype(jnp.int32)
-    byte = jnp.where(in_data, v.data, 0).astype(jnp.uint64)
+    byte = jnp.where(in_data, v.data, 0).astype(jnp.uint32)
     out = []
     for base in _HASH_BASES:
         pows = _pow_table(base, nbytes)
@@ -75,8 +79,8 @@ def string_hash2(v: DevVal) -> Tuple[jnp.ndarray, jnp.ndarray]:
         h = jax.ops.segment_sum(jnp.where(in_data, contrib, 0), rows_c,
                                 num_segments=cap)
         # Mix in length so "" vs padding rows differ and lengths disambiguate.
-        h = h + string_lengths(v).astype(jnp.uint64) * jnp.uint64(0x9E3779B97F4A7C15)
-        out.append(h)
+        h = h + string_lengths(v).astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+        out.append(h.astype(jnp.uint32))
     return out[0], out[1]
 
 
@@ -86,8 +90,8 @@ def hash_literal2(s: str) -> Tuple[int, int]:
     for base in _HASH_BASES:
         h = 0
         for b in raw:
-            h = (h * base + b) % (1 << 64)
-        h = (h + len(raw) * 0x9E3779B97F4A7C15) % (1 << 64)
+            h = (h * base + b) % (1 << 32)
+        h = (h + len(raw) * 0x9E3779B9) % (1 << 32)
         out.append(h)
     return out[0], out[1]
 
@@ -536,7 +540,7 @@ class Like(Expression):
         elif kind == "exact":
             h1, h2 = string_hash2(v)
             e1, e2 = hash_literal2(plan[1])
-            data = (h1 == jnp.uint64(e1)) & (h2 == jnp.uint64(e2))
+            data = (h1 == jnp.uint32(e1)) & (h2 == jnp.uint32(e2))
         elif kind == "prefix":
             data = _match_prefix(v, plan[1].encode())
         elif kind == "suffix":

@@ -31,29 +31,46 @@ from spark_rapids_tpu.batch import ColumnBatch, round_up_capacity
 from spark_rapids_tpu.exprs.base import DevVal
 from spark_rapids_tpu.kernels.layout import compaction_indices, gather_rows
 
-_GOLD = jnp.uint64(0x9E3779B97F4A7C15)
+_C1 = jnp.uint32(0xCC9E2D51)
+_C2 = jnp.uint32(0x1B873593)
 
 
-def _key_hash64(vals: List[DevVal]) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """(hash u64[cap], all_valid bool[cap]) over the key columns.
+def _mix32(h, w):
+    k = (w * _C1)
+    k = (k << jnp.uint32(15)) | (k >> jnp.uint32(17))
+    k = k * _C2
+    h = h ^ k
+    h = (h << jnp.uint32(13)) | (h >> jnp.uint32(19))
+    return h * jnp.uint32(5) + jnp.uint32(0xE6546B64)
 
-    Rows with any NULL key get all_valid=False and a sentinel hash of ~0
-    (sorts last, never matched — SQL null-key semantics).
-    """
+
+def _key_hash2(vals: List[DevVal]):
+    """(h1 u32[cap], h2 u32[cap], all_valid bool[cap]) over the key columns.
+
+    Two independent 32-bit hashes (native on TPU — no u64 emulation).  The
+    build side sorts by (h1, h2); probes range-scan on h1 and verify
+    exactly.  Rows with any NULL key get sentinel ~0 hashes (sort last,
+    never matched — SQL null-key semantics)."""
     cap = int(vals[0].validity.shape[0])
-    h = jnp.zeros(cap, dtype=jnp.uint64)
+    h1 = jnp.full(cap, jnp.uint32(0x12345678))
+    h2 = jnp.full(cap, jnp.uint32(0x9E3779B9))
     ok = jnp.ones(cap, dtype=jnp.bool_)
     for v in vals:
         ok = ok & v.validity
         if v.dtype.is_string:
             from spark_rapids_tpu.exprs.strings import string_hash2
-            h1, h2 = string_hash2(v)
-            w = h1 ^ (h2 * _GOLD)
+            s1, s2 = string_hash2(v)
+            words = [s1, s2,
+                     (v.offsets[1:] - v.offsets[:-1]).astype(jnp.uint32)]
         else:
-            from spark_rapids_tpu.kernels.sortkeys import _encode_fixed
-            w = _encode_fixed(v)
-        h = (h * _GOLD) ^ w ^ (h >> jnp.uint64(31))
-    return jnp.where(ok, h, ~jnp.uint64(0)), ok
+            from spark_rapids_tpu.kernels.sortkeys import \
+                _encode_fixed_words
+            words = _encode_fixed_words(v)
+        for w in words:
+            h1 = _mix32(h1, w)
+            h2 = _mix32(h2, w ^ jnp.uint32(0xA5A5A5A5))
+    sentinel = ~jnp.uint32(0)
+    return (jnp.where(ok, h1, sentinel), jnp.where(ok, h2, sentinel), ok)
 
 
 def _exact_eq(a_vals: List[DevVal], a_idx, b_vals: List[DevVal], b_idx):
@@ -70,9 +87,11 @@ def _exact_eq(a_vals: List[DevVal], a_idx, b_vals: List[DevVal], b_idx):
             eq = eq & (la == lb) & (a1[a_idx] == b1[b_idx]) & \
                 (a2[a_idx] == b2[b_idx])
         else:
-            from spark_rapids_tpu.kernels.sortkeys import _encode_fixed
-            ea, eb = _encode_fixed(va), _encode_fixed(vb)
-            eq = eq & (ea[a_idx] == eb[b_idx])
+            from spark_rapids_tpu.kernels.sortkeys import \
+                _encode_fixed_words
+            for wa, wb in zip(_encode_fixed_words(va),
+                              _encode_fixed_words(vb)):
+                eq = eq & (wa[a_idx] == wb[b_idx])
     return eq
 
 
@@ -85,9 +104,10 @@ class JoinSizing:
     build_cap: int
 
 
-def _phase1(probe_hash, probe_ok, probe_live, build_sorted_hash, build_live_n):
-    lo = jnp.searchsorted(build_sorted_hash, probe_hash, side="left")
-    hi = jnp.searchsorted(build_sorted_hash, probe_hash, side="right")
+def _phase1(probe_h1, probe_ok, probe_live, build_sorted_h1, build_live_n):
+    # candidate ranges on h1 only (h2 + exact keys verified in phase 2)
+    lo = jnp.searchsorted(build_sorted_h1, probe_h1, side="left")
+    hi = jnp.searchsorted(build_sorted_h1, probe_h1, side="right")
     counts = jnp.where(probe_ok & probe_live, hi - lo, 0).astype(jnp.int64)
     return lo.astype(jnp.int32), counts, jnp.sum(counts)
 
@@ -95,9 +115,11 @@ def _phase1(probe_hash, probe_ok, probe_live, build_sorted_hash, build_live_n):
 _phase1_jit = jax.jit(_phase1)
 
 
-def _build_sort(build_hash):
-    perm = jnp.argsort(build_hash, stable=True).astype(jnp.int32)
-    return perm, build_hash[perm]
+def _build_sort(h1, h2):
+    cap = int(h1.shape[0])
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    s1, _s2, perm = jax.lax.sort((h1, h2, iota), num_keys=2, is_stable=True)
+    return perm, s1
 
 
 _build_sort_jit = jax.jit(_build_sort)
@@ -117,13 +139,14 @@ def join_pairs(left_keys: List[DevVal], left_num_rows,
     l_live = jnp.arange(l_cap, dtype=jnp.int32) < left_num_rows
     r_live = jnp.arange(r_cap, dtype=jnp.int32) < right_num_rows
 
-    l_hash, l_ok = _key_hash64(left_keys)
-    r_hash, r_ok = _key_hash64(right_keys)
-    r_hash = jnp.where(r_live & r_ok, r_hash, ~jnp.uint64(0))
-    perm, r_sorted = _build_sort_jit(r_hash)
+    l_h1, l_h2, l_ok = _key_hash2(left_keys)
+    r_h1, r_h2, r_ok = _key_hash2(right_keys)
+    sentinel = ~jnp.uint32(0)
+    r_h1 = jnp.where(r_live & r_ok, r_h1, sentinel)
+    perm, r_sorted = _build_sort_jit(r_h1, r_h2)
     # Sentinel rows (~0 hash) are never matched because probe rows with ok
     # hash ~0 are masked by probe_ok in phase 1.
-    lo, counts, total = _phase1_jit(l_hash, l_ok, l_live, r_sorted,
+    lo, counts, total = _phase1_jit(l_h1, l_ok, l_live, r_sorted,
                                     right_num_rows)
 
     total_pairs = int(jax.device_get(total))

@@ -1,24 +1,34 @@
-"""Order-preserving uint64 sort-key encodings.
+"""Order-preserving uint32 sort-key encodings.
 
 The TPU analogue of cudf ``Table.orderBy``'s comparators
 (GpuSortExec.scala:241): every sort key column is encoded into one or more
-``uint64`` words such that *lexicographic comparison of the word tuple* equals
-the SQL ordering (ascending/descending, nulls first/last, padding rows always
-last).  ``jax.lax.sort`` over the word list then yields the permutation.
+``uint32`` words such that *lexicographic comparison of the word tuple*
+equals the SQL ordering (ascending/descending, nulls first/last, padding
+rows always last).  ``jax.lax.sort`` over the word list yields the
+permutation.
+
+Why 32-bit words: TPUs have no native 64-bit integer lanes — XLA *emulates*
+u64 arithmetic/compares, which cripples the sort that every kernel here
+(groupby, join, window, partition-split) is built on.  A 64-bit key split
+into (hi, lo) u32 words compares identically under lexicographic multi-word
+sort, and every op stays native.
 
 Encodings:
 
-* integral/date/timestamp: value ^ sign-bit (order-preserving bias to unsigned)
-* float/double: widen to f64, canonicalize NaN (Spark: NaN sorts greatest,
-  -0.0 == 0.0), then the IEEE trick — negative => flip all bits, else set sign
+* int8/16/32, date: one word — value ^ sign-bit (order-preserving bias)
+* int64/timestamp: two words — biased hi 32 bits, raw lo 32 bits
+* float/double: canonicalize NaN (Spark: NaN sorts greatest, -0.0 == 0.0),
+  then the IEEE trick in (hi, lo) form: negative => flip all bits, else set
+  the sign bit
 * boolean: 0/1
-* string: bytes padded with 0 and packed big-endian, 8 bytes per word, up to a
-  configurable prefix (``spark.rapids.sql.tpu.sort.stringPrefixBytes``,
-  default 64).  Byte 0 padding preserves "shorter prefix sorts first", which
-  matches Spark's unsigned-byte string comparison.  Strings equal in the
-  prefix tie-break by full-length + polynomial hash when exactness of
-  *grouping* matters (groupby uses that); pure sort order beyond the prefix is
-  documented as approximate, like the reference flags incompat string cases.
+* string: bytes padded with 0 and packed big-endian, 4 bytes per word, up
+  to a configurable prefix (``spark.rapids.sql.tpu.sort.stringPrefixBytes``,
+  default 64).  Byte-0 padding preserves "shorter prefix sorts first",
+  matching Spark's unsigned-byte string comparison.  Strings equal in the
+  prefix tie-break by full-length + dual 32-bit polynomial hash when
+  exactness of *grouping* matters (groupby uses that); pure sort order
+  beyond the prefix is documented approximate, like the reference flags
+  incompat string cases.
 """
 
 from __future__ import annotations
@@ -34,48 +44,65 @@ from spark_rapids_tpu.exprs.base import DevVal
 
 DEFAULT_STRING_PREFIX_BYTES = 64
 
-_SIGN64 = jnp.uint64(1 << 63)
+_SIGN32 = jnp.uint32(1 << 31)
 
 
-def _encode_fixed(v: DevVal) -> jnp.ndarray:
-    """One order-preserving u64 word for a fixed-width column's values."""
+def _encode_fixed_words(v: DevVal) -> List[jnp.ndarray]:
+    """Order-preserving u32 word list for a fixed-width column's values."""
     dt = v.dtype
     if dt == T.BOOLEAN:
-        return v.data.astype(jnp.uint64)
-    if dt.is_integral or dt.is_datetime:
+        return [v.data.astype(jnp.uint32)]
+    if dt in (T.BYTE, T.SHORT, T.INT, T.DATE):
+        x = v.data.astype(jnp.int32)
+        return [jax.lax.bitcast_convert_type(x, jnp.uint32) ^ _SIGN32]
+    if dt in (T.LONG, T.TIMESTAMP):
         x = v.data.astype(jnp.int64)
-        return jax.lax.bitcast_convert_type(x, jnp.uint64) ^ _SIGN64
-    if dt.is_fractional:
+        lo = jax.lax.convert_element_type(
+            x & jnp.int64(0xFFFFFFFF), jnp.uint32)
+        hi32 = jax.lax.convert_element_type(
+            (x >> jnp.int64(32)) & jnp.int64(0xFFFFFFFF), jnp.uint32)
+        return [hi32 ^ _SIGN32, lo]
+    if dt == T.FLOAT:
+        x = v.data.astype(jnp.float32)
+        x = jnp.where(jnp.isnan(x), jnp.float32(jnp.nan), x)
+        x = jnp.where(x == 0.0, jnp.float32(0.0), x)
+        bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
+        neg = (bits & _SIGN32) != 0
+        return [jnp.where(neg, ~bits, bits | _SIGN32)]
+    if dt == T.DOUBLE:
         x = v.data.astype(jnp.float64)
-        # Spark sort semantics: all NaNs equal and greatest; -0.0 == 0.0.
         x = jnp.where(jnp.isnan(x), jnp.float64(jnp.nan), x)
         x = jnp.where(x == 0.0, jnp.float64(0.0), x)
-        # f64 -> u32 pair -> u64 (TPU X64 rewriting lacks direct f64->u64).
-        pair = jax.lax.bitcast_convert_type(x, jnp.uint32)
-        bits = (pair[..., 1].astype(jnp.uint64) << jnp.uint64(32)) | \
-            pair[..., 0].astype(jnp.uint64)
-        neg = (bits & _SIGN64) != 0
-        return jnp.where(neg, ~bits, bits | _SIGN64)
+        pair = jax.lax.bitcast_convert_type(x, jnp.uint32)  # [..., 2] lo,hi
+        lo, hi = pair[..., 0], pair[..., 1]
+        neg = (hi & _SIGN32) != 0
+        return [jnp.where(neg, ~hi, hi | _SIGN32),
+                jnp.where(neg, ~lo, lo)]
     raise TypeError(f"cannot encode sort key of type {dt}")
 
 
+# Backwards-compatible single-word view used by equality checks.
+def _encode_fixed(v: DevVal) -> List[jnp.ndarray]:
+    return _encode_fixed_words(v)
+
+
 def string_prefix_words(col_or_val, prefix_bytes: int) -> List[jnp.ndarray]:
-    """Big-endian packed u64 words of each row's first ``prefix_bytes`` bytes."""
+    """Big-endian packed u32 words of each row's first ``prefix_bytes``
+    bytes."""
     v = col_or_val
     offsets, data = v.offsets, v.data
     cap = int(offsets.shape[0]) - 1
     nbytes = int(data.shape[0])
     lens = (offsets[1:] - offsets[:-1]).astype(jnp.int32)
     words: List[jnp.ndarray] = []
-    n_words = (prefix_bytes + 7) // 8
-    row = jnp.arange(cap, dtype=jnp.int32)
+    n_words = (prefix_bytes + 3) // 4
     for w in range(n_words):
-        word = jnp.zeros(cap, dtype=jnp.uint64)
-        for b in range(8):
-            j = w * 8 + b
+        word = jnp.zeros(cap, dtype=jnp.uint32)
+        for b in range(4):
+            j = w * 4 + b
             src = jnp.clip(offsets[:-1] + j, 0, nbytes - 1)
-            byte = jnp.where(j < lens, data[src], 0).astype(jnp.uint64)
-            word = (word << jnp.uint64(8)) | byte
+            byte = jnp.where(j < lens, data[src], 0).astype(jnp.uint32)
+            word = (word << jnp.uint32(8)) | byte
         words.append(word)
     return words
 
@@ -84,22 +111,21 @@ def encode_sort_keys(vals: List[DevVal], ascendings: List[bool],
                      nulls_firsts: List[bool], num_rows,
                      string_prefix_bytes: int = DEFAULT_STRING_PREFIX_BYTES
                      ) -> List[jnp.ndarray]:
-    """Full key-word list for a multi-column sort.
+    """Full u32 key-word list for a multi-column sort.
 
     Word 0 forces padding rows (row >= num_rows) to the end; each key column
-    contributes a null-rank word then its value word(s).
-    """
+    contributes a null-rank word then its value word(s)."""
     cap = int(vals[0].validity.shape[0]) if vals else 0
     live = jnp.arange(cap, dtype=jnp.int32) < num_rows
-    words: List[jnp.ndarray] = [jnp.where(live, 0, 1).astype(jnp.uint64)]
+    words: List[jnp.ndarray] = [jnp.where(live, 0, 1).astype(jnp.uint32)]
     for v, asc, nf in zip(vals, ascendings, nulls_firsts):
         null_rank = jnp.where(v.validity, 1, 0) if nf else \
             jnp.where(v.validity, 0, 1)
-        words.append(null_rank.astype(jnp.uint64))
+        words.append(null_rank.astype(jnp.uint32))
         if v.dtype.is_string:
             vwords = string_prefix_words(v, string_prefix_bytes)
         else:
-            vwords = [_encode_fixed(v)]
+            vwords = _encode_fixed_words(v)
         for w in vwords:
             w = jnp.where(v.validity, w, 0)  # nulls all compare equal
             words.append(w if asc else ~w)
@@ -117,10 +143,10 @@ def argsort_by_words(words: List[jnp.ndarray], cap: int) -> jnp.ndarray:
 def keys_equal_prev(vals: List[DevVal]) -> jnp.ndarray:
     """bool[cap]: row i's key tuple exactly equals row i-1's (False at i=0).
 
-    Used by sort-based groupby for exact segment boundaries.  Strings compare
-    by (length, prefix words, dual 64-bit full hash) — an engineered-collision
-    risk only, far stronger than the 32-bit hashes the reference partitions by.
-    """
+    Used by sort-based groupby for exact segment boundaries.  Strings
+    compare by (length, prefix words, dual 32-bit polynomial full hash) —
+    an engineered-collision risk only, comparable to the reference
+    partitioning on 32-bit murmur3."""
     cap = int(vals[0].validity.shape[0])
     eq = jnp.ones(cap, dtype=jnp.bool_)
 
@@ -134,11 +160,14 @@ def keys_equal_prev(vals: List[DevVal]) -> jnp.ndarray:
             from spark_rapids_tpu.exprs.strings import string_hash2
             lens = (v.offsets[1:] - v.offsets[:-1]).astype(jnp.int32)
             h1, h2 = string_hash2(v)
-            for x in (lens, h1, h2):
+            cmp_words = [lens, h1, h2] + string_prefix_words(
+                v, DEFAULT_STRING_PREFIX_BYTES)
+            for x in cmp_words:
                 same = ~shift_ne(x)
                 eq = eq & jnp.where(v.validity, same, True)
         else:
-            same = ~shift_ne(_encode_fixed(v))
-            eq = eq & jnp.where(v.validity, same, True)
+            for w in _encode_fixed_words(v):
+                same = ~shift_ne(w)
+                eq = eq & jnp.where(v.validity, same, True)
     eq = eq.at[0].set(False)
     return eq

@@ -156,7 +156,7 @@ class RangePartitioning(Partitioning):
             pid = pid + gt.astype(jnp.int32)
         return pid
 
-    def _encode_bound(self, bound: tuple) -> List[np.uint64]:
+    def _encode_bound(self, bound: tuple) -> list:
         """Encode one host bound row with the same word scheme as
         encode_sort_keys (minus the liveness word)."""
         from spark_rapids_tpu.batch import HostBatch, HostColumn, \

@@ -149,12 +149,42 @@ class DeviceToHostExec(CpuExec):
         return [gen(p) for p in child_parts]
 
 
+def run_partition_with_retry(root: PhysicalOp, ctx: ExecContext,
+                             index: int) -> List:
+    """Materialize one partition with retries (Spark task-retry analogue —
+    SURVEY.md section 5: failure detection is delegated to task retry +
+    lineage; partitions are pure recomputations of their lineage here too).
+    """
+    max_failures = int(ctx.conf.get("spark.rapids.task.maxFailures", 2))
+    last_err = None
+    for attempt in range(max(1, max_failures)):
+        try:
+            return list(root.partitions(ctx)[index])
+        except MemoryError:
+            raise
+        except Exception as e:  # noqa: BLE001 — retried, then re-raised
+            last_err = e
+            ctx.metric("task", "retries").add(1)
+    raise last_err
+
+
 def collect_host(op: PhysicalOp, ctx: ExecContext) -> HostBatch:
     """Drive a plan to completion and concatenate all partitions on host."""
     root = op if not op.is_tpu else DeviceToHostExec(op)
     batches: List[HostBatch] = []
-    for part in root.partitions(ctx):
-        batches.extend(part)
+    t0 = time.monotonic()
+    parts = root.partitions(ctx)
+    for i, part in enumerate(parts):
+        try:
+            got = list(part)
+        except MemoryError:
+            raise
+        except Exception:
+            got = run_partition_with_retry(root, ctx, i)
+        batches.extend(got)
+        ctx.metric("collect", "batches").add(len(got))
+    ctx.metric("collect", "wallTimeNs").add(
+        int((time.monotonic() - t0) * 1e9))
     if not batches:
         return HostBatch(op.output_schema, [
             _empty_host_col(f) for f in op.output_schema.fields
