@@ -108,7 +108,7 @@ def _phase1(probe_h1, probe_ok, probe_live, build_sorted_h1, build_live_n):
     # candidate ranges on h1 only (h2 + exact keys verified in phase 2)
     lo = jnp.searchsorted(build_sorted_h1, probe_h1, side="left")
     hi = jnp.searchsorted(build_sorted_h1, probe_h1, side="right")
-    counts = jnp.where(probe_ok & probe_live, hi - lo, 0).astype(jnp.int64)
+    counts = jnp.where(probe_ok & probe_live, hi - lo, 0).astype(jnp.int32)
     return lo.astype(jnp.int32), counts, jnp.sum(counts)
 
 
@@ -158,7 +158,7 @@ def join_pairs(left_keys: List[DevVal], left_num_rows,
     def phase2(lo, counts, perm, l_keys, r_keys, total):
         cum = jnp.cumsum(counts)
         starts = cum - counts
-        k = jnp.arange(pair_cap, dtype=jnp.int64)
+        k = jnp.arange(pair_cap, dtype=jnp.int32)
         probe_row = jnp.searchsorted(cum, k, side="right").astype(jnp.int32)
         probe_row = jnp.clip(probe_row, 0, l_cap - 1)
         ordinal = (k - starts[probe_row]).astype(jnp.int32)
@@ -172,7 +172,7 @@ def join_pairs(left_keys: List[DevVal], left_num_rows,
         l_idx = probe_row[order]
         r_idx = build_row[order]
         # per-left-row match counts + right matched flags (for outer joins)
-        ones = match.astype(jnp.int64)
+        ones = match.astype(jnp.int32)
         l_counts = jax.ops.segment_sum(ones, probe_row, num_segments=l_cap)
         r_matched = jax.ops.segment_max(
             ones, build_row, num_segments=r_cap) > 0

@@ -50,8 +50,10 @@ def shrink_to_fit(batch: ColumnBatch) -> ColumnBatch:
     byte_caps = []
     for c in batch.columns:
         if c.is_string:
-            off = jax.device_get(c.offsets)
-            byte_caps.append(round_up_capacity(max(int(off[n]), 16),
+            # offsets are constant past num_rows, so offsets[-1] is the
+            # byte total — fetch ONE scalar, not the whole array
+            total = int(jax.device_get(c.offsets[-1]))
+            byte_caps.append(round_up_capacity(max(total, 16),
                                                minimum=16))
     idx = jnp.arange(cap, dtype=jnp.int32)
     return gather_rows(batch, idx, jnp.asarray(n, jnp.int32),
@@ -74,8 +76,7 @@ def _concat_all(batches: List[ColumnBatch], schema: T.Schema
         if f.dtype.is_string:
             tot = 0
             for b in batches:
-                off = jax.device_get(b.columns[i].offsets)
-                tot += int(off[-1])
+                tot += int(jax.device_get(b.columns[i].offsets[-1]))
             byte_caps.append(round_up_capacity(max(tot, 16), minimum=16))
     acc = batches[0]
     for nxt in batches[1:]:
