@@ -27,6 +27,10 @@ class TpuSparkSession:
         from spark_rapids_tpu.runtime.device import DeviceRuntime
         self.runtime = DeviceRuntime.get(self.conf) if use_device else None
         self._views: Dict[str, Any] = {}
+        # logical-plan -> physical-plan memo: repeated executions of the
+        # same DataFrame reuse exec instances and therefore their jax.jit
+        # caches (otherwise every collect() recompiles every kernel).
+        self._plan_cache: Dict[int, Any] = {}
         with TpuSparkSession._lock:
             TpuSparkSession._active = self
 
@@ -89,11 +93,28 @@ class TpuSparkSession:
 
     # -- execution ----------------------------------------------------------
 
-    def execute(self, plan) -> HostBatch:
+    def plan_physical(self, plan):
+        """Lower a logical plan, memoized per (plan identity, conf state)."""
         from spark_rapids_tpu.plan.overrides import TpuOverrides
-        from spark_rapids_tpu.plan.physical import ExecContext, collect_host
+        key = id(plan)
+        conf_state = tuple(sorted(
+            (k, str(v)) for k, v in self.conf._settings.items()))
+        hit = self._plan_cache.get(key)
+        if hit is not None and hit[0] is plan and hit[1] == conf_state:
+            self.last_explain = hit[3]
+            return hit[2]
         overrides = TpuOverrides(self.conf)
         phys = overrides.apply(plan)
+        if len(self._plan_cache) > 256:
+            self._plan_cache.clear()
+        self._plan_cache[key] = (plan, conf_state, phys,
+                                 overrides.last_explain)
+        self.last_explain = overrides.last_explain
+        return phys
+
+    def execute(self, plan) -> HostBatch:
+        from spark_rapids_tpu.plan.physical import ExecContext, collect_host
+        phys = self.plan_physical(plan)
         if self.conf.test_enforce_tpu:
             _assert_on_tpu(phys)
         ctx = ExecContext(
@@ -101,7 +122,6 @@ class TpuSparkSession:
             semaphore=self.runtime.semaphore if self.runtime else None,
             device=self.runtime.device if self.runtime else None)
         self.last_physical_plan = phys
-        self.last_explain = overrides.last_explain
         return collect_host(phys, ctx)
 
     def explain_plan(self, plan) -> str:
