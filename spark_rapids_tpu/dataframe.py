@@ -512,17 +512,46 @@ class DataFrame:
         print(s)
         return s
 
-    def write_parquet(self, path: str, mode: str = "error"):
+    def write_parquet(self, path: str, mode: str = "error",
+                      partition_by=None):
         from spark_rapids_tpu.io.writer import write_dataframe
-        write_dataframe(self, "parquet", path, mode)
+        return write_dataframe(self, "parquet", path, mode,
+                               partition_by=partition_by)
 
     def write_csv(self, path: str, mode: str = "error"):
         from spark_rapids_tpu.io.writer import write_dataframe
-        write_dataframe(self, "csv", path, mode)
+        return write_dataframe(self, "csv", path, mode)
 
     def write_orc(self, path: str, mode: str = "error"):
         from spark_rapids_tpu.io.writer import write_dataframe
-        write_dataframe(self, "orc", path, mode)
+        return write_dataframe(self, "orc", path, mode)
+
+    # -- conveniences -------------------------------------------------------
+
+    def head(self, n: int = 1):
+        rows = self.limit(n).collect()
+        return rows[0] if n == 1 and rows else rows
+
+    def first(self):
+        return self.head(1)
+
+    def take(self, n: int):
+        return self.limit(n).collect()
+
+    def is_empty(self) -> bool:
+        return not self.limit(1).collect()
+
+    @property
+    def dtypes(self):
+        return [(f.name, f.dtype.name) for f in self.schema.fields]
+
+    def print_schema(self):
+        print("root")
+        for f in self.schema.fields:
+            null = "true" if f.nullable else "false"
+            print(f" |-- {f.name}: {f.dtype} (nullable = {null})")
+
+    printSchema = print_schema
 
 
 def _dedupe_right(left: "DataFrame", right: "DataFrame", is_semi: bool):
