@@ -136,6 +136,11 @@ class WindowExpression(Expression):
                 return f"{fn.name} requires ORDER BY"
             return None
         if isinstance(fn, Lag):
+            if len(fn.children) > 1 and fn.children[0].dtype.is_string:
+                # ops/window.py has no string default-fill yet; route to
+                # CPU instead of silently returning NULL for the default.
+                return (f"{fn.name} with a default value on a string "
+                        f"column not supported on TPU")
             return None
         if isinstance(fn, AggregateFunction):
             from spark_rapids_tpu.exprs.aggregates import (

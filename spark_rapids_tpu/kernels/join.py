@@ -80,12 +80,23 @@ def _exact_eq(a_vals: List[DevVal], a_idx, b_vals: List[DevVal], b_idx):
         eq = eq & va.validity[a_idx] & vb.validity[b_idx]
         if va.dtype.is_string:
             from spark_rapids_tpu.exprs.strings import string_hash2
+            from spark_rapids_tpu.kernels.sortkeys import (
+                DEFAULT_STRING_PREFIX_BYTES, string_prefix_words,
+            )
             la = (va.offsets[1:] - va.offsets[:-1])[a_idx]
             lb = (vb.offsets[1:] - vb.offsets[:-1])[b_idx]
             a1, a2 = string_hash2(va)
             b1, b2 = string_hash2(vb)
             eq = eq & (la == lb) & (a1[a_idx] == b1[b_idx]) & \
                 (a2[a_idx] == b2[b_idx])
+            # Also compare the first 64 bytes exactly: a false match now
+            # needs simultaneous collision of both 32-bit hashes AND an
+            # identical 64-byte prefix + length — residual risk documented
+            # in docs/compatibility.md.
+            for wa, wb in zip(
+                    string_prefix_words(va, DEFAULT_STRING_PREFIX_BYTES),
+                    string_prefix_words(vb, DEFAULT_STRING_PREFIX_BYTES)):
+                eq = eq & (wa[a_idx] == wb[b_idx])
         else:
             from spark_rapids_tpu.kernels.sortkeys import \
                 _encode_fixed_words

@@ -123,7 +123,19 @@ def encode_sort_keys(vals: List[DevVal], ascendings: List[bool],
             jnp.where(v.validity, 0, 1)
         words.append(null_rank.astype(jnp.uint32))
         if v.dtype.is_string:
+            # Prefix words order the sort; the trailing (length, h1, h2)
+            # tie-break words guarantee that *fully equal* strings always
+            # sort adjacent even past the prefix, so group_segments /
+            # window partitioning (which test full equality via
+            # keys_equal_prev) never split one group across a run of
+            # prefix-equal strings.  Beyond-prefix *order* between unequal
+            # strings remains approximate (documented).
+            from spark_rapids_tpu.exprs.strings import string_hash2
             vwords = string_prefix_words(v, string_prefix_bytes)
+            lens = (v.offsets[1:] - v.offsets[:-1]).astype(jnp.uint32)
+            h1, h2 = string_hash2(v)
+            vwords = vwords + [lens, h1.astype(jnp.uint32),
+                               h2.astype(jnp.uint32)]
         else:
             vwords = _encode_fixed_words(v)
         for w in vwords:

@@ -96,7 +96,6 @@ def _col_buffers(col) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]:
         lens = np.fromiter((len(e) for e in encoded), dtype=np.int64,
                            count=len(encoded))
         offsets = np.zeros(len(encoded) + 1, dtype=np.int32)
-        np.cumsum(lens, out=offsets[1:].astype(np.int64, copy=False))
         offsets[1:] = np.cumsum(lens)
         data = np.frombuffer(b"".join(encoded), dtype=np.uint8).copy()
         return data, col.validity.astype(np.uint8), offsets

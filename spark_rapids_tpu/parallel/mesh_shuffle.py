@@ -31,7 +31,27 @@ DATA_AXIS = "data"
 
 
 def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """n-device 1-D mesh on the ``data`` axis.
+
+    When the default platform has fewer than ``n_devices`` chips (e.g. a
+    single real TPU during development), fall back to the CPU backend's
+    virtual devices (``--xla_force_host_platform_device_count``) so mesh
+    logic is exercised without hardware — the same trick tests/conftest.py
+    uses.  Raises if no backend can supply ``n_devices`` devices.
+    """
     devs = jax.devices()
+    if n_devices is not None and len(devs) < n_devices:
+        try:
+            cpu = jax.devices("cpu")
+        except RuntimeError:
+            cpu = []
+        if len(cpu) >= n_devices:
+            devs = cpu
+        else:
+            raise RuntimeError(
+                f"need {n_devices} devices, default platform has "
+                f"{len(devs)} and cpu has {len(cpu)}; set JAX_PLATFORMS=cpu "
+                f"and --xla_force_host_platform_device_count={n_devices}")
     n = n_devices or len(devs)
     return Mesh(np.array(devs[:n]), (DATA_AXIS,))
 

@@ -3,9 +3,14 @@ GpuRangePartitioner.scala, GpuRoundRobinPartitioning.scala,
 GpuSinglePartitioning.scala — SURVEY.md section 2.5).
 
 Each strategy computes a target-partition id per row, on device (for TPU
-exchanges) and on host (CPU exchanges + oracle).  Hash partitioning is
-Spark-compatible murmur3 pmod, so CPU and TPU place every row identically —
-required for mixed CPU/TPU plans to line up at joins.
+exchanges) and on host (CPU exchanges + oracle).  Hash partitioning uses
+murmur3 pmod over per-type hash words; for fixed-width types the words are
+the raw value bits (Spark-compatible placement), but for strings murmur3 is
+fed this engine's internal polynomial hash words rather than the UTF-8
+bytes, so string placement is internally consistent (CPU and TPU place
+every row identically — required for mixed CPU/TPU plans to line up at
+joins) but NOT byte-compatible with Apache Spark's murmur3 string hashing.
+See docs/compatibility.md.
 """
 
 from __future__ import annotations

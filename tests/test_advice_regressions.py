@@ -1,0 +1,78 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md).
+
+1. Long (>64-byte prefix) equal strings must group together even when a
+   *different* string shares their 64-byte prefix and sits between them in
+   input order (sortkeys.py tie-break words).
+2. Join on long strings sharing a prefix must not cross-match.
+3. lag/lead with a default on a string column must fall back to CPU (and
+   therefore honour the default) instead of silently emitting NULL.
+"""
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu.functions import Window
+
+from compare import assert_tpu_cpu_equal
+
+
+def _long(prefix_char: str, tail: str, n: int = 80) -> str:
+    return prefix_char * n + tail
+
+
+class TestLongStringGrouping:
+    def test_equal_long_strings_group_once_despite_prefix_collision(self):
+        # a and b share an 80-char prefix; two copies of a bracket b in
+        # input order.  Before the tie-break fix the stable sort could
+        # leave them non-adjacent -> duplicate groups.
+        a = _long("x", "AAAA")
+        b = _long("x", "BBBB")
+        data = {"s": [a, b, a, b, a, None, b],
+                "v": [1, 10, 2, 20, 3, 100, 30]}
+
+        def q(s):
+            df = s.create_dataframe(data, num_partitions=2)
+            return df.group_by("s").agg(F.sum("v").alias("sv"),
+                                        F.count("v").alias("c"))
+
+        assert_tpu_cpu_equal(q)
+
+    def test_sorted_equal_long_strings_adjacent(self):
+        a = _long("p", "1")
+        b = _long("p", "2")
+        c = _long("p", "3")
+        data = {"s": [b, a, c, a, b, c, a], "v": list(range(7))}
+
+        def q(s):
+            df = s.create_dataframe(data, num_partitions=1)
+            # window partition over s: each partition must see exactly its
+            # own rows (row_number + per-partition sum)
+            w = Window.partition_by("s").order_by("v")
+            return df.with_column("rn", F.row_number().over(w)) \
+                     .with_column("ps", F.sum("v").over(w))
+
+        assert_tpu_cpu_equal(q)
+
+    def test_long_string_join_no_prefix_cross_match(self):
+        a = _long("k", "left")
+        b = _long("k", "right")
+        left = {"k": [a, b], "lv": [1, 2]}
+        right = {"k": [a, b, a], "rv": [10, 20, 30]}
+
+        def q(s):
+            l = s.create_dataframe(left, num_partitions=2)
+            r = s.create_dataframe(right, num_partitions=2)
+            return l.join(r, on="k", how="inner")
+
+        assert_tpu_cpu_equal(q)
+
+
+class TestLagLeadStringDefault:
+    def test_lag_string_default_falls_back(self):
+        data = {"g": [1, 1, 1, 2, 2], "o": [1, 2, 3, 1, 2],
+                "s": ["a", "b", "c", "d", "e"]}
+
+        def q(s):
+            df = s.create_dataframe(data, num_partitions=1)
+            w = Window.partition_by("g").order_by("o")
+            return df.with_column("p", F.lag("s", 1, "DEFAULT").over(w))
+
+        assert_tpu_cpu_equal(q, expect_fallback="Lag")
