@@ -54,6 +54,10 @@ def time_engine(tpu_enabled: bool, data, runs: int = 3) -> float:
     conf = RapidsConf({
         "spark.rapids.sql.enabled": tpu_enabled,
         "spark.sql.shuffle.partitions": PARTS,
+        # Float sum/avg reduce in a data-parallel order on the accelerator;
+        # the reference's benchmarks run with the same gate enabled
+        # (RapidsConf.scala:400-421 hasNans/variableFloatAgg knobs).
+        "spark.rapids.sql.variableFloatAgg.enabled": True,
     })
     s = TpuSparkSession(conf)
     q = build_query(s, data)

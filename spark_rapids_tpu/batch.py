@@ -285,23 +285,54 @@ def host_to_device(batch: HostBatch, capacity: Optional[int] = None,
     return ColumnBatch(batch.schema, cols, num_rows, cap)
 
 
+def device_to_host_many(batches: Sequence[ColumnBatch]) -> List[HostBatch]:
+    # ONE bulk device_get for all batches: jax prefetches every leaf with
+    # copy_to_host_async before blocking, so all buffers ride a single
+    # round trip.  Per-column gets serialize one RTT each — over a tunneled
+    # device that dominated query wall time (see profile_bench.py).
+    host = jax.device_get([
+        (b.num_rows,
+         [(c.data, c.validity, c.offsets) if c.is_string
+          else (c.data, c.validity) for c in b.columns])
+        for b in batches])
+    out = []
+    for batch, (num_rows, col_bufs) in zip(batches, host):
+        n = int(num_rows)
+        out_cols = []
+        for f, bufs in zip(batch.schema.fields, col_bufs):
+            validity = np.asarray(bufs[1])[:n]
+            if f.dtype.is_string:
+                data = np.asarray(bufs[0])
+                offsets = np.asarray(bufs[2])
+                values = np.empty(n, dtype=object)
+                for i in range(n):
+                    values[i] = bytes(
+                        data[offsets[i]:offsets[i + 1]]).decode(
+                        "utf-8", errors="replace")
+                out_cols.append(HostColumn(f.dtype, values, validity))
+            else:
+                data = np.asarray(bufs[0])[:n]
+                out_cols.append(HostColumn(f.dtype, data, validity))
+        out.append(HostBatch(batch.schema, out_cols))
+    return out
+
+
 def device_to_host(batch: ColumnBatch) -> HostBatch:
-    n = batch.host_num_rows()
-    out_cols = []
-    for f, c in zip(batch.schema.fields, batch.columns):
-        validity = np.asarray(jax.device_get(c.validity))[:n]
-        if f.dtype.is_string:
-            offsets = np.asarray(jax.device_get(c.offsets))
-            data = np.asarray(jax.device_get(c.data))
-            values = np.empty(n, dtype=object)
-            for i in range(n):
-                values[i] = bytes(data[offsets[i]:offsets[i + 1]]).decode(
-                    "utf-8", errors="replace")
-            out_cols.append(HostColumn(f.dtype, values, validity))
-        else:
-            data = np.asarray(jax.device_get(c.data))[:n]
-            out_cols.append(HostColumn(f.dtype, data, validity))
-    return HostBatch(batch.schema, out_cols)
+    return device_to_host_many([batch])[0]
+
+
+def host_sizes(batches: Sequence[ColumnBatch]) -> List[Tuple[int, List[int]]]:
+    """Fetch (num_rows, [string byte totals...]) for many batches in ONE
+    blocking transfer (one round trip instead of one per scalar).
+
+    String byte totals read ``offsets[-1]`` — valid because offsets are
+    constant past num_rows by construction.
+    """
+    scalars = [(b.num_rows,
+                [c.offsets[-1] for c in b.columns if c.is_string])
+               for b in batches]
+    host = jax.device_get(scalars)
+    return [(int(n), [int(t) for t in totals]) for n, totals in host]
 
 
 def empty_device_batch(schema: T.Schema, capacity: int = MIN_CAPACITY) -> ColumnBatch:

@@ -88,13 +88,20 @@ def compaction_indices(mask, num_rows):
     """(indices, count): stable order of rows where mask is True and live.
 
     ``indices`` is int32[cap] — positions of kept rows first (stable),
-    then arbitrary padding.
+    then arbitrary padding.  Sort-free: a cumsum ranks the kept rows and
+    searchsorted inverts the ranking — a boolean stable-argsort is an
+    O(n log^2 n) bitonic sort on TPU (~300 ms at 2M rows) while
+    cumsum+searchsorted is a couple of HBM passes.
     """
     cap = int(mask.shape[0])
     live = jnp.arange(cap, dtype=jnp.int32) < num_rows
     keep = mask & live
-    order = jnp.argsort(jnp.where(keep, 0, 1), stable=True).astype(jnp.int32)
-    return order, jnp.sum(keep).astype(jnp.int32)
+    csum = jnp.cumsum(keep.astype(jnp.int32))
+    count = csum[cap - 1] if cap else jnp.int32(0)
+    idx = jnp.searchsorted(
+        csum, jnp.arange(1, cap + 1, dtype=jnp.int32),
+        side="left").astype(jnp.int32)
+    return jnp.clip(idx, 0, cap - 1), count.astype(jnp.int32)
 
 
 def compact(batch: ColumnBatch, mask) -> ColumnBatch:

@@ -38,6 +38,8 @@ from typing import List, Optional
 import jax
 import jax.numpy as jnp
 
+import numpy as np
+
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.batch import DeviceColumn
 from spark_rapids_tpu.exprs.base import DevVal
@@ -45,6 +47,18 @@ from spark_rapids_tpu.exprs.base import DevVal
 DEFAULT_STRING_PREFIX_BYTES = 64
 
 _SIGN32 = jnp.uint32(1 << 31)
+
+# f64 order words are backend-dependent:
+#
+# * CPU (tests, oracle, virtual mesh): real IEEE f64 — bitcast to a
+#   (hi, lo) u32 pair, exact.
+# * TPU: XLA emulates f64 as a float-float pair (two f32s: hi + lo), with
+#   f32's exponent range — bitcasts of emulated f64 fail to compile, and
+#   values outside ~[1e-38, 3.4e38] are already inf/0 on device.  The
+#   emulation's own (hi, lo) split IS the encoding: s1 = f32(x),
+#   s2 = f32(x - s1), compared lexicographically (standard double-float
+#   comparison), using only native f32 bitcasts.  See
+#   docs/compatibility.md "Double precision on TPU".
 
 
 def _encode_fixed_words(v: DevVal) -> List[jnp.ndarray]:
@@ -70,15 +84,53 @@ def _encode_fixed_words(v: DevVal) -> List[jnp.ndarray]:
         neg = (bits & _SIGN32) != 0
         return [jnp.where(neg, ~bits, bits | _SIGN32)]
     if dt == T.DOUBLE:
-        x = v.data.astype(jnp.float64)
-        x = jnp.where(jnp.isnan(x), jnp.float64(jnp.nan), x)
-        x = jnp.where(x == 0.0, jnp.float64(0.0), x)
-        pair = jax.lax.bitcast_convert_type(x, jnp.uint32)  # [..., 2] lo,hi
-        lo, hi = pair[..., 0], pair[..., 1]
-        neg = (hi & _SIGN32) != 0
-        return [jnp.where(neg, ~hi, hi | _SIGN32),
-                jnp.where(neg, ~lo, lo)]
+        return _encode_double_words(v.data)
     raise TypeError(f"cannot encode sort key of type {dt}")
+
+
+def _enc_f32_bits(f):
+    """Order-preserving u32 encoding of a (native) f32 array."""
+    bits = jax.lax.bitcast_convert_type(f.astype(jnp.float32), jnp.uint32)
+    neg = (bits & _SIGN32) != 0
+    return jnp.where(neg, ~bits, bits | _SIGN32)
+
+
+def _encode_double_words(data) -> List[jnp.ndarray]:
+    """u32 order words for f64 (Spark order: -inf..-0=0..+inf, NaN
+    greatest), injective on device-representable canonicalized values."""
+    if jax.default_backend() == "tpu":
+        return _encode_double_words_ff(data)
+    return _encode_double_words_bitcast(data)
+
+
+def _encode_double_words_bitcast(data) -> List[jnp.ndarray]:
+    """Exact (hi, lo) u32 pair via bitcast — real-f64 backends only."""
+    x = data.astype(jnp.float64)
+    x = jnp.where(jnp.isnan(x), jnp.float64(jnp.nan), x)
+    x = jnp.where(x == 0.0, jnp.float64(0.0), x)
+    pair = jax.lax.bitcast_convert_type(x, jnp.uint32)  # [..., 2] lo,hi
+    lo, hi = pair[..., 0], pair[..., 1]
+    neg = (hi & _SIGN32) != 0
+    return [jnp.where(neg, ~hi, hi | _SIGN32),
+            jnp.where(neg, ~lo, lo)]
+
+
+def _encode_double_words_ff(data) -> List[jnp.ndarray]:
+    """(nan-class, enc32(hi), enc32(lo)) for float-float-emulated f64.
+
+    x < y  <=>  (f32(x), x - f32(x)) lexicographic (standard double-float
+    comparison; both components signed, ordered by the f32 encoding).
+    """
+    x = data.astype(jnp.float64)
+    isnan = jnp.isnan(x)
+    x = jnp.where(isnan, jnp.float64(0.0), x)
+    x = jnp.where(x == 0.0, jnp.float64(0.0), x)  # -0 -> +0
+    s1 = x.astype(jnp.float32)
+    r1 = x - s1.astype(jnp.float64)
+    r1 = jnp.where(jnp.isinf(x), jnp.float64(0.0), r1)  # inf - inf = nan
+    s2 = r1.astype(jnp.float32)
+    cls = jnp.where(isnan, jnp.uint32(1), jnp.uint32(0))
+    return [cls, _enc_f32_bits(s1), _enc_f32_bits(s2)]
 
 
 # Backwards-compatible single-word view used by equality checks.

@@ -34,50 +34,55 @@ from spark_rapids_tpu.kernels.sort import sort_batch
 from spark_rapids_tpu.plan.physical import ExecContext, PhysicalOp, TpuExec
 
 
-def shrink_to_fit(batch: ColumnBatch) -> ColumnBatch:
+def shrink_to_fit(batch: ColumnBatch,
+                  sizes: Optional[tuple] = None) -> ColumnBatch:
     """Re-bucket a sparse batch down to its live-row count.
 
     The padded-capacity model means ops like filter/aggregate can leave
     batches with few live rows in huge buffers; every downstream kernel then
-    pays O(capacity).  At pipeline breaks (exchanges, agg partials) we pay
-    one host sync + gather to move to the right power-of-two bucket — the
-    CoalesceGoal/TargetSize analogue in reverse (GpuCoalesceBatches.scala).
+    pays O(capacity).  At pipeline breaks we pay one host sync + gather to
+    move to the right power-of-two bucket — the CoalesceGoal/TargetSize
+    analogue in reverse (GpuCoalesceBatches.scala).
+
+    ``sizes`` is an optional pre-fetched (num_rows, [string byte totals])
+    pair (see :func:`~spark_rapids_tpu.batch.host_sizes`) so callers
+    shrinking many batches pay ONE round trip, not one per batch.
     """
-    n = batch.host_num_rows()
+    from spark_rapids_tpu.batch import host_sizes
+    if sizes is None:
+        sizes = host_sizes([batch])[0]
+    n, str_totals = sizes
     cap = round_up_capacity(max(n, 1))
     if batch.capacity <= cap * 2:
         return batch
-    byte_caps = []
-    for c in batch.columns:
-        if c.is_string:
-            # offsets are constant past num_rows, so offsets[-1] is the
-            # byte total — fetch ONE scalar, not the whole array
-            total = int(jax.device_get(c.offsets[-1]))
-            byte_caps.append(round_up_capacity(max(total, 16),
-                                               minimum=16))
+    byte_caps = [round_up_capacity(max(t, 16), minimum=16)
+                 for t in str_totals]
     idx = jnp.arange(cap, dtype=jnp.int32)
     return gather_rows(batch, idx, jnp.asarray(n, jnp.int32),
                        out_capacity=cap, out_byte_caps=byte_caps or None)
 
 
-def _concat_all(batches: List[ColumnBatch], schema: T.Schema
+def _concat_all(batches: List[ColumnBatch], schema: T.Schema,
+                sizes: Optional[List[tuple]] = None
                 ) -> Optional[ColumnBatch]:
     """Concatenate a partition's batches into one (RequireSingleBatch goal,
     GpuCoalesceBatches.scala:105-110).  Sizes the output by host-visible
-    row totals (one sync per partition — acceptable at pipeline breaks)."""
+    totals, fetched in ONE round trip for all batches (or passed in
+    pre-fetched via ``sizes``)."""
     if not batches:
         return None
     if len(batches) == 1:
         return batches[0]
-    total_rows = sum(b.host_num_rows() for b in batches)
+    from spark_rapids_tpu.batch import host_sizes
+    if sizes is None:
+        sizes = host_sizes(batches)
+    total_rows = sum(n for n, _ in sizes)
     cap = round_up_capacity(max(total_rows, 1))
-    byte_caps = []
-    for i, f in enumerate(schema.fields):
-        if f.dtype.is_string:
-            tot = 0
-            for b in batches:
-                tot += int(jax.device_get(b.columns[i].offsets[-1]))
-            byte_caps.append(round_up_capacity(max(tot, 16), minimum=16))
+    n_str = sum(1 for f in schema.fields if f.dtype.is_string)
+    byte_caps = [
+        round_up_capacity(max(sum(s[1][j] for s in sizes), 16), minimum=16)
+        for j in range(n_str)
+    ]
     acc = batches[0]
     for nxt in batches[1:]:
         acc = concat_pair(acc, nxt, cap,
@@ -136,6 +141,10 @@ class TpuProjectExec(TpuExec):
     def describe(self):
         return f"TpuProject({', '.join(f.name for f in self.output_schema)})"
 
+    def pipeline_inline(self, ctx, build):
+        cf = build(self.children[0])
+        return lambda args: [self.batch_fn(b) for b in cf(args)]
+
     def partitions(self, ctx):
         return [map(self._run, p)
                 for p in self.children[0].partitions(ctx)]
@@ -158,6 +167,10 @@ class TpuFilterExec(TpuExec):
     def describe(self):
         return f"TpuFilter({self.condition!r})"
 
+    def pipeline_inline(self, ctx, build):
+        cf = build(self.children[0])
+        return lambda args: [self.batch_fn(b) for b in cf(args)]
+
     def partitions(self, ctx):
         return [map(self._run, p)
                 for p in self.children[0].partitions(ctx)]
@@ -169,6 +182,19 @@ class TpuUnionExec(TpuExec):
 
     def num_partitions(self, ctx):
         return sum(c.num_partitions(ctx) for c in self.children)
+
+    def pipeline_inline(self, ctx, build):
+        cfs = [build(c) for c in self.children]
+
+        def f(args):
+            out = []
+            for cf in cfs:
+                for b in cf(args):
+                    out.append(ColumnBatch(self.output_schema, b.columns,
+                                           b.num_rows, b.capacity))
+            return out
+
+        return f
 
     def partitions(self, ctx):
         out = []
@@ -191,6 +217,11 @@ class TpuCoalesceBatchesExec(TpuExec):
     def __init__(self, child: PhysicalOp, target_rows: int = 1 << 20):
         super().__init__([child], child.output_schema)
         self.target_rows = target_rows
+
+    def pipeline_inline(self, ctx, build):
+        # inside one compiled program batches are virtual — coalescing
+        # is a no-op (consumers concat statically where they need to)
+        return build(self.children[0])
 
     def partitions(self, ctx):
         def gen(part):
@@ -238,6 +269,10 @@ class TpuFusedMapExec(TpuExec):
     def describe(self):
         return f"TpuFusedMap({' -> '.join(self.labels)})"
 
+    def pipeline_inline(self, ctx, build):
+        cf = build(self.children[0])
+        return lambda args: [self.batch_fn(b) for b in cf(args)]
+
     def partitions(self, ctx):
         return [map(self._run, p)
                 for p in self.children[0].partitions(ctx)]
@@ -247,6 +282,20 @@ class TpuLocalLimitExec(TpuExec):
     def __init__(self, n: int, child: PhysicalOp):
         super().__init__([child], child.output_schema)
         self.n = n
+
+    def pipeline_inline(self, ctx, build):
+        cf = build(self.children[0])
+
+        def f(args):
+            out = []
+            left = jnp.asarray(self.n, jnp.int32)
+            for b in cf(args):
+                h = take_head(b, left)
+                left = jnp.maximum(left - h.num_rows, 0)
+                out.append(h)
+            return out
+
+        return f
 
     def partitions(self, ctx):
         def gen(part):
@@ -294,6 +343,18 @@ class TpuSortExec(TpuExec):
     def describe(self):
         return f"TpuSort({len(self.orders)} keys)"
 
+    def pipeline_inline(self, ctx, build):
+        from spark_rapids_tpu.plan.pipeline import concat_static
+        cf = build(self.children[0])
+
+        def f(args):
+            batches = cf(args)
+            if not batches:
+                return []
+            return [self._run(concat_static(batches, self.output_schema))]
+
+        return f
+
     def partitions(self, ctx):
         def gen(part):
             merged = _concat_all(list(part), self.output_schema)
@@ -329,6 +390,10 @@ class TpuHashAggregateExec(TpuExec):
         assert mode in ("update", "merge")
         super().__init__([child], schema)
         self.mode = mode
+        # partial outputs have far fewer live rows than capacity: end the
+        # compiled stage here so the driver re-buckets before downstream
+        # concats/sorts pay O(padded capacity)
+        self.pipeline_stage_break = (mode == "update")
         self.key_exprs = key_exprs
         self.key_names = key_names
         self.aggs = aggs
@@ -363,6 +428,31 @@ class TpuHashAggregateExec(TpuExec):
 
     def describe(self):
         return f"TpuHashAggregate({self.mode}, keys={len(self.key_exprs)})"
+
+    def pipeline_inline(self, ctx, build):
+        from spark_rapids_tpu.plan.pipeline import concat_static
+        cf = build(self.children[0])
+        child_schema = self.children[0].output_schema
+
+        def f(args):
+            batches = cf(args)
+            for fn in self._input_fns:  # absorbed map stages
+                batches = [fn(b) for b in batches]
+            if self.mode == "update":
+                partials = [self._aggregate_batch(b) for b in batches]
+                if len(partials) <= 1:
+                    return partials
+                merged = concat_static(partials, self.output_schema)
+                return [self._merge_partials(merged)]
+            if not batches:
+                if self.key_exprs:
+                    return []
+                merged = empty_device_batch(child_schema)
+            else:
+                merged = concat_static(batches, child_schema)
+            return [self._aggregate_batch(merged)]
+
+        return f
 
     # -- core ---------------------------------------------------------------
 
@@ -440,13 +530,21 @@ class TpuHashAggregateExec(TpuExec):
             # ones so one compiled merge covers a worthwhile row count and
             # downstream sees fewer partitions.
             parts = [list(p) for p in self.children[0].partitions(ctx)]
+            from spark_rapids_tpu.batch import host_sizes
+            all_sizes: dict = {}
             if ctx.conf.get(
                     "spark.rapids.sql.adaptive.coalescePartitions.enabled",
                     True) not in (False, "false") and len(parts) > 1:
                 target = int(ctx.conf.get(
                     "spark.rapids.sql.adaptive.targetPartitionRows",
                     1 << 16))
-                sizes = [sum(b.host_num_rows() for b in p) for p in parts]
+                # one round trip for every batch's sizes across ALL
+                # partitions (row counts + string byte totals), reused by
+                # the concat below
+                flat = [b for p in parts for b in p]
+                flat_sizes = host_sizes(flat) if flat else []
+                all_sizes = {id(b): s for b, s in zip(flat, flat_sizes)}
+                sizes = [sum(all_sizes[id(b)][0] for b in p) for p in parts]
                 groups, cur, cur_rows = [], [], 0
                 for pp, sz in zip(parts, sizes):
                     cur.extend(pp)
@@ -459,29 +557,34 @@ class TpuHashAggregateExec(TpuExec):
                 parts = groups
 
             def gen(batches):
-                merged = _concat_all(batches, child_schema)
+                pre = [all_sizes[id(b)] for b in batches] \
+                    if batches and all(id(b) in all_sizes for b in batches) \
+                    else None
+                merged = _concat_all(batches, child_schema, sizes=pre)
                 if merged is None:
                     if self.key_exprs:
                         return
                     # keyless reduction on empty input -> SQL default row
                     merged = empty_device_batch(child_schema)
-                yield shrink_to_fit(self._run(merged))
+                yield self._run(merged)
 
             return [gen(p) for p in parts]
         else:
             # update mode: aggregate each batch, then combine this
             # partition's partials: concat + buffer-merge (the reference's
             # concatenateBatches + merge-aggregate loop,
-            # aggregate.scala:434-492).
+            # aggregate.scala:434-492).  Partials stay in their input-sized
+            # buffers (no per-batch host sync); the downstream pipeline
+            # break right-sizes them in one round trip.
             def gen(part):
-                partials = [shrink_to_fit(self._run(db)) for db in part]
+                partials = [self._run(db) for db in part]
                 if not partials:
                     return
                 if len(partials) == 1:
                     yield partials[0]
                     return
                 merged = _concat_all(partials, self.output_schema)
-                yield shrink_to_fit(self._merge_run(merged))
+                yield self._merge_run(merged)
 
         return [gen(p) for p in self.children[0].partitions(ctx)]
 
@@ -631,6 +734,11 @@ class TpuExpandExec(TpuExec):
                                        batch.capacity)
                 return run
             self._runs.append(make())
+
+    def pipeline_inline(self, ctx, build):
+        cf = build(self.children[0])
+        return lambda args: [run(b) for b in cf(args)
+                             for run in self._runs]
 
     def partitions(self, ctx):
         def gen(part):

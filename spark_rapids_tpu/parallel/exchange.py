@@ -31,6 +31,18 @@ from spark_rapids_tpu.plan.physical import (
 _RANGE_SAMPLE_ROWS = 4096
 
 
+def _collapse_local_conf(ctx) -> bool:
+    """Single-process execution doesn't need a physical split: every
+    downstream consumer sees all rows either way, and partitioning only
+    constrains *placement* (trivially satisfied by one partition).
+    Collapsing removes the per-batch count sync + one gather per target
+    partition — pure overhead on one device.  The mesh (multi-device)
+    path does its own all-to-all instead."""
+    return ctx.conf.get(
+        "spark.rapids.sql.tpu.exchange.collapseLocal", True) \
+        not in (False, "false")
+
+
 class CpuShuffleExchangeExec(CpuExec):
     def __init__(self, partitioning: Partitioning, child: PhysicalOp):
         super().__init__([child], child.output_schema)
@@ -41,11 +53,23 @@ class CpuShuffleExchangeExec(CpuExec):
         return f"CpuShuffleExchange({type(p).__name__}, {p.num_partitions})"
 
     def num_partitions(self, ctx):
+        if _collapse_local_conf(ctx):
+            return 1
         return self.partitioning.num_partitions
 
     def partitions(self, ctx):
         n = self.partitioning.num_partitions
         in_parts = self.children[0].partitions(ctx)
+        if _collapse_local_conf(ctx):
+            # mirror the TPU exchange's local collapse so CPU and TPU
+            # plans keep identical deterministic row orders (the compare
+            # harness and mixed plans rely on it)
+            def gen():
+                for part in in_parts:
+                    for hb in part:
+                        yield hb
+
+            return [gen()]
         all_batches: List[List[HostBatch]] = [list(p) for p in in_parts]
         if isinstance(self.partitioning, RangePartitioning):
             self.partitioning.prepare(_sample_host_keys(
@@ -88,6 +112,7 @@ class TpuShuffleExchangeExec(TpuExec):
         super().__init__([child], child.output_schema)
         self.partitioning = partitioning
         self._input_fns = []
+        self._fused_map = None
         self._sort_by_pid = jax.jit(self._sort_by_pid_impl,
                                     static_argnames=("n",))
 
@@ -95,13 +120,33 @@ class TpuShuffleExchangeExec(TpuExec):
         """Fuse upstream map-like stages into the partition-split program
         (one dispatch per batch for filter+project+hash+sort-by-pid)."""
         self._input_fns = list(fns)
+        self._fused_map = None
+
+    def _collapse_local(self, ctx) -> bool:
+        return _collapse_local_conf(ctx)
 
     def describe(self):
         p = self.partitioning
         return f"TpuShuffleExchange({type(p).__name__}, {p.num_partitions})"
 
     def num_partitions(self, ctx):
+        if self._collapse_local(ctx):
+            return 1
         return self.partitioning.num_partitions
+
+    def pipeline_inline(self, ctx, build):
+        if not self._collapse_local(ctx):
+            return None
+        cf = build(self.children[0])
+        fns = list(self._input_fns)
+
+        def f(args):
+            bs = cf(args)
+            for fn in fns:
+                bs = [fn(b) for b in bs]
+            return bs
+
+        return f
 
     def _sort_by_pid_impl(self, batch: ColumnBatch, part_index, n: int):
         """One pass: rows reordered so each target partition's rows are
@@ -128,6 +173,27 @@ class TpuShuffleExchangeExec(TpuExec):
     def partitions(self, ctx):
         n = self.partitioning.num_partitions
         in_parts = self.children[0].partitions(ctx)
+        if self._collapse_local(ctx):
+            # one logical partition holding every input batch (with any
+            # absorbed map stages applied as one fused program per batch);
+            # no pid computation, no split, no sampling, no host syncs
+            if self._input_fns and self._fused_map is None:
+                fns = list(self._input_fns)
+
+                def composed(b):
+                    for f in fns:
+                        b = f(b)
+                    return b
+
+                self._fused_map = jax.jit(composed)
+
+            def gen():
+                for part in in_parts:
+                    for db in part:
+                        yield self._fused_map(db) if self._fused_map \
+                            else db
+
+            return [gen()]
         all_batches: List[List[ColumnBatch]] = [list(p) for p in in_parts]
         if isinstance(self.partitioning, RangePartitioning):
             self.partitioning.prepare(

@@ -93,6 +93,13 @@ class TpuExec(PhysicalOp):
 
     is_tpu = True
 
+    def pipeline_inline(self, ctx: "ExecContext", build):
+        """Whole-pipeline hook (plan/pipeline.py): return
+        f(args) -> List[ColumnBatch] composing this op into one jitted
+        program (``build(child)`` composes a child), or None to act as a
+        pipeline source fed through the iterator path."""
+        return None
+
 
 class CpuExec(PhysicalOp):
     """Host fallback operator over HostBatch partitions."""
@@ -139,8 +146,12 @@ class DeviceToHostExec(CpuExec):
         child_parts = self.children[0].partitions(ctx)
 
         def gen(part):
+            from spark_rapids_tpu.ops.tpu_exec import shrink_to_fit
             for db in part:
-                hb = device_to_host(db)
+                # Shrink to the live-row bucket first (one scalar round
+                # trip + a device-side gather) so the bulk transfer moves
+                # live rows, not padded capacity.
+                hb = device_to_host(shrink_to_fit(db))
                 if ctx.semaphore is not None:
                     ctx.semaphore.release()
                 if hb.num_rows:
@@ -170,6 +181,11 @@ def run_partition_with_retry(root: PhysicalOp, ctx: ExecContext,
 
 def collect_host(op: PhysicalOp, ctx: ExecContext) -> HostBatch:
     """Drive a plan to completion and concatenate all partitions on host."""
+    if op.is_tpu:
+        from spark_rapids_tpu.plan.pipeline import pipeline_collect
+        hb = pipeline_collect(op, ctx)
+        if hb is not None:
+            return hb
     root = op if not op.is_tpu else DeviceToHostExec(op)
     batches: List[HostBatch] = []
     t0 = time.monotonic()

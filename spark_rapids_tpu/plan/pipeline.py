@@ -1,0 +1,186 @@
+"""Whole-pipeline compilation: run an entire TPU query stage as ONE XLA
+program (a few, at capacity-reduction boundaries).
+
+The reference amortizes per-op JNI dispatch with batch-level cudf calls; on
+TPU (especially a remotely-tunneled one) every dispatched program and every
+blocking host transfer costs a round trip that dwarfs the compute, so the
+engine's steady state must execute O(1) programs per query, not O(ops).
+This module composes the per-batch functions of an all-TPU physical subtree
+(map stages, collapsed exchanges, aggregate update/merge, sort, limit,
+expand, union) into jitted stage functions over the source batches — the
+TPU-native analogue of Spark whole-stage codegen, with XLA doing the
+fusion.
+
+Stage boundaries ("stage breaks") sit where live rows collapse far below
+capacity (aggregate partials): the driver syncs the live sizes once (one
+round trip), re-buckets with a compiled gather, and feeds the shrunk
+batches to the next stage — otherwise padded capacities would snowball
+through concats and every downstream sort would pay O(padded).
+
+Ops that cannot be inlined (host transitions, joins needing host-visible
+output sizing, samples with host RNG) become pipeline *sources*: their
+iterator path materializes batches that feed the program as arguments.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.batch import (
+    ColumnBatch, HostBatch, device_to_host_many, host_sizes,
+    round_up_capacity,
+)
+from spark_rapids_tpu.plan.physical import ExecContext, PhysicalOp, TpuExec
+
+
+def concat_static(batches: List[ColumnBatch], schema: T.Schema
+                  ) -> ColumnBatch:
+    """In-jit concatenation: output capacity = sum of input *capacities*
+    (static — no host sync).  Stage breaks pay the padding back."""
+    from spark_rapids_tpu.kernels.layout import concat_pair
+    if len(batches) == 1:
+        return batches[0]
+    cap = round_up_capacity(sum(b.capacity for b in batches))
+    byte_caps = []
+    for i, f in enumerate(schema.fields):
+        if f.dtype.is_string:
+            byte_caps.append(round_up_capacity(
+                sum(int(b.columns[i].data.shape[0]) for b in batches),
+                minimum=16))
+    acc = batches[0]
+    for nxt in batches[1:]:
+        acc = concat_pair(acc, nxt, cap, out_byte_caps=byte_caps or None)
+    return acc
+
+
+def build_pipeline(op: PhysicalOp, ctx: ExecContext,
+                   sources: List[PhysicalOp], memo: dict,
+                   root: PhysicalOp) -> Callable:
+    """Recursively compose ``op`` into f(args) -> List[ColumnBatch].
+
+    ``args`` is a tuple aligned with ``sources``: args[i] is the tuple of
+    batches materialized from sources[i].  Ops whose ``pipeline_inline``
+    returns None — and stage-break ops below the stage root — become
+    sources.
+    """
+    if id(op) in memo:
+        return memo[id(op)]
+    f = None
+    if isinstance(op, TpuExec) and not (
+            op is not root and getattr(op, "pipeline_stage_break", False)):
+        f = op.pipeline_inline(
+            ctx,
+            lambda child: build_pipeline(child, ctx, sources, memo, root))
+    if f is None:
+        idx = len(sources)
+        sources.append(op)
+        f = lambda args, _i=idx: list(args[_i])  # noqa: E731
+    memo[id(op)] = f
+    return f
+
+
+# Padded outputs smaller than this skip the sizes round-trip + shrink.
+_SHRINK_BYTES = 4 << 20
+
+
+def _batch_padded_bytes(b: ColumnBatch) -> int:
+    total = 0
+    for c in b.columns:
+        total += c.data.size * c.data.dtype.itemsize
+        total += c.validity.size * c.validity.dtype.itemsize
+        if c.offsets is not None:
+            total += c.offsets.size * c.offsets.dtype.itemsize
+    return total
+
+
+@functools.partial(jax.jit, static_argnames=("caps", "bcapss"))
+def _shrink_jit(bs: Tuple[ColumnBatch, ...], caps: Tuple[int, ...],
+                bcapss: Tuple[Tuple[int, ...], ...]):
+    from spark_rapids_tpu.kernels.layout import gather_rows
+    out = []
+    for b, cap, bcaps in zip(bs, caps, bcapss):
+        idx = jnp.arange(cap, dtype=jnp.int32)
+        out.append(gather_rows(b, idx, b.num_rows, out_capacity=cap,
+                               out_byte_caps=list(bcaps) or None))
+    return tuple(out)
+
+
+def _shrink_outputs(outs: List[ColumnBatch], ctx: ExecContext
+                    ) -> List[ColumnBatch]:
+    """Sizes round trip + one compiled gather re-bucketing every batch."""
+    if not outs or sum(_batch_padded_bytes(b) for b in outs) <= _SHRINK_BYTES:
+        return outs
+    sizes = host_sizes(outs)
+    ctx.metric("pipeline", "shrinks").add(1)
+    caps = tuple(round_up_capacity(max(n, 1)) for n, _ in sizes)
+    bcapss = tuple(
+        tuple(round_up_capacity(max(t, 16), minimum=16) for t in totals)
+        for _, totals in sizes)
+    return list(_shrink_jit(tuple(outs), caps, bcapss))
+
+
+def _materialize_source(src: PhysicalOp, ctx: ExecContext
+                        ) -> List[ColumnBatch]:
+    from spark_rapids_tpu.plan.physical import HostToDeviceExec
+    if getattr(src, "pipeline_stage_break", False):
+        return _run_stage(src, ctx)
+    batches = []
+    for part in src.partitions(ctx):
+        batches.extend(part)
+    if isinstance(src, HostToDeviceExec):
+        ctx._pipeline_h2d = getattr(ctx, "_pipeline_h2d", 0) + len(batches)
+    return batches
+
+
+def _run_stage(root: PhysicalOp, ctx: ExecContext) -> List[ColumnBatch]:
+    """Execute ``root``'s stage as one program; shrunk device outputs."""
+    cached = getattr(root, "_stage_cache", None)
+    if cached is None:
+        sources: List[PhysicalOp] = []
+        fn = build_pipeline(root, ctx, sources, {}, root)
+        jitted = jax.jit(lambda args: tuple(fn(args)))
+        cached = (sources, jitted)
+        root._stage_cache = cached
+    sources, jitted = cached
+    args = tuple(tuple(_materialize_source(s, ctx)) for s in sources)
+    ctx.metric("pipeline", "programs").add(1)
+    return _shrink_outputs(list(jitted(args)), ctx)
+
+
+def pipeline_collect(root: PhysicalOp, ctx: ExecContext
+                     ) -> Optional[HostBatch]:
+    """Try to run ``root`` as a whole-pipeline program; None if the plan
+    doesn't inline anything (caller falls back to the iterator path)."""
+    if not root.is_tpu:
+        return None
+    if ctx.conf.get("spark.rapids.sql.tpu.pipeline.enabled", True) \
+            in (False, "false"):
+        return None
+
+    probe = getattr(root, "_pipeline_viable", None)
+    if probe is None:
+        sources: List[PhysicalOp] = []
+        build_pipeline(root, ctx, sources, {}, root)
+        probe = not (len(sources) == 1 and sources[0] is root)
+        root._pipeline_viable = probe
+    if not probe:
+        return None
+
+    ctx._pipeline_h2d = 0
+    try:
+        outs = _run_stage(root, ctx)
+        hbs = [hb for hb in device_to_host_many(outs) if hb.num_rows]
+    finally:
+        if ctx.semaphore is not None:
+            for _ in range(getattr(ctx, "_pipeline_h2d", 0)):
+                ctx.semaphore.release()
+    if not hbs:
+        from spark_rapids_tpu.plan.physical import _empty_host_col
+        return HostBatch(root.output_schema, [
+            _empty_host_col(f) for f in root.output_schema.fields])
+    return HostBatch.concat(hbs)
