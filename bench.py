@@ -13,8 +13,8 @@ import time
 
 import numpy as np
 
-ROWS = 1 << 21  # 2M rows
-PARTS = 4
+ROWS = 1 << 24  # 16M rows — large enough that per-dispatch round-trip
+PARTS = 4       # latency (~100ms over the tunneled chip) amortizes
 
 
 def make_data(rows: int):

@@ -238,9 +238,11 @@ STRING_HASH_JOIN = conf_bool(
     "Group by / join on string keys via 64-bit hashes computed on device; "
     "collisions are astronomically unlikely but theoretically possible.")
 ENABLE_ICI_SHUFFLE = conf_bool(
-    "spark.rapids.shuffle.ici.enabled", True,
-    "Use the ICI all-to-all collective shuffle when a multi-chip mesh is "
-    "available; otherwise fall back to the host exchange.")
+    "spark.rapids.shuffle.ici.enabled", False,
+    "Route shuffle exchanges through the ICI lax.all_to_all collective "
+    "over the device mesh when >1 device is available.  Opt-in, like the "
+    "reference's RapidsShuffleManager (docs/get-started.md); off means the "
+    "single-host exchange path.")
 PINNED_POOL_SIZE = conf_bytes(
     "spark.rapids.memory.pinnedPool.size", 0,
     "Size of the pinned host staging pool used by the native runtime for "

@@ -30,12 +30,17 @@ from spark_rapids_tpu.exprs.mathexprs import (
 )
 from spark_rapids_tpu.exprs.datetime import (
     Year, Month, DayOfMonth, DayOfWeek, DayOfYear, Quarter, Hour, Minute, Second,
-    DateAdd, DateSub, DateDiff, LastDay,
+    DateAdd, DateSub, DateDiff, LastDay, UnixTimestamp, FromUnixTime,
 )
 from spark_rapids_tpu.exprs.strings import (
     Length, Upper, Lower, Substring, StringStartsWith, StringEndsWith,
     StringContains, ConcatStrings, Like, StringTrim, StringTrimLeft, StringTrimRight,
-    StringReplace, StringLocate, StringRPad, StringLPad,
+    StringReplace, StringLocate, StringRPad, StringLPad, RegExpReplace,
+    SplitPart, ConcatWs,
+)
+from spark_rapids_tpu.exprs.bitwise import (
+    BitwiseAnd, BitwiseOr, BitwiseXor, BitwiseNot, ShiftLeft, ShiftRight,
+    ShiftRightUnsigned,
 )
 from spark_rapids_tpu.exprs.aggregates import (
     AggregateExpression, Sum, Count, Min, Max, Average, First, Last,

@@ -1,0 +1,140 @@
+"""Bitwise expressions (reference: bitwise.scala, 145 LoC — GpuBitwiseAnd/
+Or/Xor/Not, GpuShiftLeft/Right/RightUnsigned).
+
+Java shift semantics: the shift amount is masked to the operand width
+(``x << (s & 31)`` for int, ``& 63`` for long); ``>>>`` is a logical shift
+implemented via an unsigned view.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exprs.base import (
+    BinaryExpression, CpuVal, DevVal, UnaryExpression, promote_cpu,
+    promote_dev,
+)
+
+
+class _BitwiseBinary(BinaryExpression):
+    def _compute(self, x, y):
+        raise NotImplementedError
+
+    def tpu_supported(self, conf):
+        for c in (self.left, self.right):
+            if not c.dtype.is_integral:
+                return f"bitwise op needs integral inputs, got {c.dtype}"
+        return None
+
+    def tpu_eval(self, ctx) -> DevVal:
+        a, b, out = promote_dev(self.left.tpu_eval(ctx),
+                                self.right.tpu_eval(ctx))
+        data = self._compute(a.data, b.data)
+        return DevVal(out, data.astype(out.jnp_dtype),
+                      a.validity & b.validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        a, b, out = promote_cpu(self.left.cpu_eval(ctx),
+                                self.right.cpu_eval(ctx))
+        data = self._compute(a.values, b.values)
+        return CpuVal(out, data.astype(out.np_dtype),
+                      a.validity & b.validity)
+
+
+class BitwiseAnd(_BitwiseBinary):
+    def _compute(self, x, y):
+        return x & y
+
+
+class BitwiseOr(_BitwiseBinary):
+    def _compute(self, x, y):
+        return x | y
+
+
+class BitwiseXor(_BitwiseBinary):
+    def _compute(self, x, y):
+        return x ^ y
+
+
+class BitwiseNot(UnaryExpression):
+    def _resolve_type(self):
+        self.dtype = self.child.dtype
+        self.nullable = self.child.nullable
+
+    def tpu_supported(self, conf):
+        if not self.child.dtype.is_integral:
+            return f"bitwise not needs an integral input, got {self.child.dtype}"
+        return None
+
+    def tpu_eval(self, ctx) -> DevVal:
+        v = self.child.tpu_eval(ctx)
+        return DevVal(v.dtype, ~v.data, v.validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        v = self.child.cpu_eval(ctx)
+        return CpuVal(v.dtype, ~v.values, v.validity)
+
+
+class _Shift(BinaryExpression):
+    """Base: value {int,long} shifted by an int amount (java-masked)."""
+
+    def _resolve_type(self):
+        self.dtype = self.left.dtype if self.left.dtype == T.LONG else T.INT
+        self.nullable = self.left.nullable or self.right.nullable
+
+    def tpu_supported(self, conf):
+        if self.left.dtype not in (T.BYTE, T.SHORT, T.INT, T.LONG):
+            return f"shift needs an integral value, got {self.left.dtype}"
+        if not self.right.dtype.is_integral:
+            return f"shift amount must be integral, got {self.right.dtype}"
+        return None
+
+    def _mask(self):
+        return 63 if self.dtype == T.LONG else 31
+
+    def _compute(self, x, s, xp):
+        raise NotImplementedError
+
+    def tpu_eval(self, ctx) -> DevVal:
+        a = self.left.tpu_eval(ctx)
+        b = self.right.tpu_eval(ctx)
+        x = a.data.astype(self.dtype.jnp_dtype)
+        s = (b.data.astype(jnp.int32) & self._mask()).astype(x.dtype)
+        data = self._compute(x, s, jnp)
+        return DevVal(self.dtype, data.astype(self.dtype.jnp_dtype),
+                      a.validity & b.validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        a = self.left.cpu_eval(ctx)
+        b = self.right.cpu_eval(ctx)
+        x = a.values.astype(self.dtype.np_dtype)
+        s = (b.values.astype(np.int64) & self._mask()).astype(x.dtype)
+        with np.errstate(all="ignore"):
+            data = self._compute(x, s, np)
+        return CpuVal(self.dtype, data.astype(self.dtype.np_dtype),
+                      a.validity & b.validity)
+
+
+class ShiftLeft(_Shift):
+    def _compute(self, x, s, xp):
+        return x << s
+
+
+class ShiftRight(_Shift):
+    """Arithmetic shift (java >>): sign-extending."""
+
+    def _compute(self, x, s, xp):
+        return x >> s
+
+
+class ShiftRightUnsigned(_Shift):
+    """Logical shift (java >>>): shift the unsigned bit pattern."""
+
+    def _compute(self, x, s, xp):
+        udt = xp.uint64 if self.dtype == T.LONG else xp.uint32
+        ux = x.view(udt) if xp is np else x.astype(udt)
+        us = s.view(udt) if xp is np else s.astype(udt)
+        shifted = ux >> us
+        return shifted.view(x.dtype) if xp is np else shifted.astype(x.dtype)

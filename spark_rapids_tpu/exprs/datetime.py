@@ -218,3 +218,134 @@ class LastDay(UnaryExpression):
         v = self.child.cpu_eval(ctx)
         return CpuVal(T.DATE, self._last_day(v.values, np).astype(np.int32),
                       v.validity)
+
+
+class UnixTimestamp(UnaryExpression):
+    """unix_timestamp(ts|date) -> LONG seconds since epoch
+    (GpuUnixTimestamp, datetimeExpressions.scala).  String parsing runs on
+    CPU (default 'yyyy-MM-dd HH:mm:ss' format only)."""
+
+    def _resolve_type(self):
+        self.dtype = T.LONG
+        self.nullable = True
+
+    def tpu_supported(self, conf):
+        if self.child.dtype == T.STRING:
+            return "unix_timestamp string parsing runs on CPU"
+        if self.child.dtype not in (T.DATE, T.TIMESTAMP):
+            return f"unix_timestamp needs date/timestamp/string, " \
+                f"got {self.child.dtype}"
+        return None
+
+    def tpu_eval(self, ctx) -> DevVal:
+        v = self.child.tpu_eval(ctx)
+        if self.child.dtype == T.DATE:
+            data = v.data.astype(jnp.int64) * 86_400
+        else:
+            # floor division keeps pre-epoch instants correct
+            data = jnp.floor_divide(v.data.astype(jnp.int64), 1_000_000)
+        return DevVal(T.LONG, data, v.validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        v = self.child.cpu_eval(ctx)
+        if self.child.dtype == T.DATE:
+            return CpuVal(T.LONG, v.values.astype(np.int64) * 86_400,
+                          v.validity)
+        if self.child.dtype == T.TIMESTAMP:
+            return CpuVal(T.LONG,
+                          np.floor_divide(v.values.astype(np.int64),
+                                          1_000_000), v.validity)
+        # string: default Spark format
+        import datetime as _dt
+        out = np.zeros(len(v.values), dtype=np.int64)
+        valid = np.array(v.validity, copy=True)
+        for i, (s, ok) in enumerate(zip(v.values, v.validity)):
+            if not ok:
+                continue
+            try:
+                t = _dt.datetime.strptime(str(s), "%Y-%m-%d %H:%M:%S")
+                out[i] = int(t.replace(tzinfo=_dt.timezone.utc).timestamp())
+            except ValueError:
+                valid[i] = False
+        return CpuVal(T.LONG, out, valid)
+
+
+class FromUnixTime(UnaryExpression):
+    """from_unixtime(seconds) -> 'yyyy-MM-dd HH:mm:ss' string
+    (GpuFromUnixTime).  Only the default format runs on TPU; the output is
+    fixed-width so the byte buffer is a [cap, 19] digit computation."""
+
+    FMT = "yyyy-MM-dd HH:mm:ss"
+
+    def __init__(self, child, fmt: str = FMT):
+        self.fmt = str(fmt)
+        super().__init__(child)
+
+    def with_children(self, children):
+        return FromUnixTime(children[0], self.fmt)
+
+    def _resolve_type(self):
+        self.dtype = T.STRING
+        self.nullable = self.child.nullable
+
+    def tpu_supported(self, conf):
+        if self.fmt != self.FMT:
+            return f"from_unixtime format {self.fmt!r} runs on CPU"
+        if not self.child.dtype.is_integral:
+            return f"from_unixtime needs integral seconds, " \
+                f"got {self.child.dtype}"
+        return None
+
+    def tpu_eval(self, ctx) -> DevVal:
+        v = self.child.tpu_eval(ctx)
+        cap = ctx.capacity
+        secs = v.data.astype(jnp.int64)
+        days = jnp.floor_divide(secs, 86_400)
+        tod = secs - days * 86_400
+        y, m, d = civil_from_days(days, jnp)
+        hh = tod // 3_600
+        mi = (tod // 60) % 60
+        ss = tod % 60
+        # fixed-width 19-byte rows: columns of digits, flattened
+        def dig(x, p):
+            return ((x // p) % 10 + 48).astype(jnp.uint8)
+        cols = [
+            dig(y, 1000), dig(y, 100), dig(y, 10), dig(y, 1),
+            jnp.full(cap, 45, jnp.uint8),
+            dig(m, 10), dig(m, 1),
+            jnp.full(cap, 45, jnp.uint8),
+            dig(d, 10), dig(d, 1),
+            jnp.full(cap, 32, jnp.uint8),
+            dig(hh, 10), dig(hh, 1),
+            jnp.full(cap, 58, jnp.uint8),
+            dig(mi, 10), dig(mi, 1),
+            jnp.full(cap, 58, jnp.uint8),
+            dig(ss, 10), dig(ss, 1),
+        ]
+        mat = jnp.stack(cols, axis=1)  # [cap, 19]
+        live = v.validity & ctx.row_mask
+        lens = jnp.where(live, 19, 0).astype(jnp.int32)
+        offsets = jnp.concatenate([
+            jnp.zeros(1, jnp.int32), jnp.cumsum(lens).astype(jnp.int32)])
+        nbytes = cap * 19
+        pos = jnp.arange(nbytes, dtype=jnp.int32)
+        row = jnp.clip(jnp.searchsorted(offsets[1:], pos, side="right"),
+                       0, cap - 1).astype(jnp.int32)
+        within = jnp.clip(pos - offsets[row], 0, 18)
+        data = jnp.where(pos < offsets[-1], mat[row, within], 0)
+        return DevVal(T.STRING, data.astype(jnp.uint8), v.validity, offsets)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        import datetime as _dt
+        v = self.child.cpu_eval(ctx)
+        fmt = (self.fmt.replace("yyyy", "%Y").replace("MM", "%m")
+               .replace("dd", "%d").replace("HH", "%H")
+               .replace("mm", "%M").replace("ss", "%S"))
+        out = np.empty(len(v.values), dtype=object)
+        for i, (s, ok) in enumerate(zip(v.values, v.validity)):
+            if not ok:
+                out[i] = ""
+                continue
+            t = _dt.datetime.fromtimestamp(int(s), tz=_dt.timezone.utc)
+            out[i] = t.strftime(fmt)
+        return CpuVal(T.STRING, out, v.validity)

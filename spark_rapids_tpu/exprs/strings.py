@@ -617,6 +617,58 @@ def _has_self_overlap(needle: bytes) -> bool:
     return False
 
 
+def _replace_match_starts(v: DevVal, match, Ls: int, repl: bytes,
+                          ctx) -> DevVal:
+    """Replace every Ls-byte run beginning at a True position of ``match``
+    (bool[nbytes], match starts fully inside their row, non-overlapping)
+    with ``repl``.  Scatter-formulated: copied bytes and replacement bytes
+    land at positions shifted by (Lr-Ls) per preceding in-row match."""
+    cap = v.capacity
+    nbytes = int(v.data.shape[0])
+    Lr = len(repl)
+    rows = jnp.clip(rows_of_positions(v.offsets, nbytes), 0, cap - 1)
+    n_matches = jax.ops.segment_sum(match.astype(jnp.int32), rows,
+                                    num_segments=cap)
+    lens = string_lengths(v)
+    new_lens = lens + n_matches * (Lr - Ls)
+    new_lens = jnp.where(v.validity & ctx.row_mask, new_lens, 0)
+    out_cap = nbytes if Lr <= Ls else nbytes + (nbytes // Ls) * (Lr - Ls)
+    row_first_byte = v.offsets[rows]
+    pos_in_row = jnp.arange(nbytes, dtype=jnp.int32) - row_first_byte
+    starts_i = match.astype(jnp.int32)
+    # covered[i] = any match start in (i-Ls, i] -> byte i is replaced.
+    csum = jnp.concatenate([jnp.zeros(1, dtype=jnp.int32),
+                            jnp.cumsum(starts_i)])
+    lo = jnp.maximum(jnp.arange(nbytes) - Ls + 1, 0)
+    covered = (csum[jnp.arange(nbytes) + 1] - csum[lo]) > 0
+    # Matches before byte i in the same row:
+    m_before = csum[jnp.arange(nbytes)]  # global matches strictly before i
+    m_before_row_start = csum[jnp.clip(row_first_byte, 0, nbytes)]
+    m_in_row_before = m_before - m_before_row_start
+    # Output position of each *copied* byte and each *match start*:
+    out_pos_copy = pos_in_row + m_in_row_before * (Lr - Ls)
+    # Build output via scatter of copied bytes, then scatter replacement
+    # bytes at match starts.
+    out_offsets = jnp.concatenate([
+        jnp.zeros(1, dtype=jnp.int32),
+        jnp.cumsum(new_lens).astype(jnp.int32)])
+    out_base = out_offsets[rows]
+    out_idx_copy = out_base + out_pos_copy
+    in_data_mask = jnp.arange(nbytes, dtype=jnp.int32) < v.offsets[-1]
+    valid_copy = in_data_mask & ~covered
+    out = jnp.zeros(out_cap, dtype=jnp.uint8)
+    out = out.at[jnp.where(valid_copy, out_idx_copy, out_cap)].set(
+        v.data, mode="drop")
+    # match starts: the match at input pos i (m_in_row_before matches
+    # before it) maps to output position pos_in_row + m_in_row_before*(Lr-Ls)
+    out_idx_match = out_base + pos_in_row + m_in_row_before * (Lr - Ls)
+    for k, bch in enumerate(repl):
+        out = out.at[jnp.where(match & in_data_mask, out_idx_match + k,
+                               out_cap)].set(
+            jnp.full(nbytes, bch, dtype=jnp.uint8), mode="drop")
+    return DevVal(T.STRING, out, v.validity, out_offsets)
+
+
 class StringReplace(Expression):
     """replace(str, search, replacement) with literal search/replacement."""
 
@@ -647,52 +699,8 @@ class StringReplace(Expression):
         v = self.children[0].tpu_eval(ctx)
         search = _literal_needle(self.children[1]).encode("utf-8")
         repl = _literal_needle(self.children[2]).encode("utf-8")
-        cap = v.capacity
-        nbytes = int(v.data.shape[0])
-        Ls, Lr = len(search), len(repl)
         match = _find_matches(v, search)
-        rows = jnp.clip(rows_of_positions(v.offsets, nbytes), 0, cap - 1)
-        n_matches = jax.ops.segment_sum(match.astype(jnp.int32), rows,
-                                        num_segments=cap)
-        lens = string_lengths(v)
-        new_lens = lens + n_matches * (Lr - Ls)
-        new_lens = jnp.where(v.validity & ctx.row_mask, new_lens, 0)
-        out_cap = nbytes if Lr <= Ls else nbytes + (nbytes // Ls) * (Lr - Ls)
-        row_first_byte = v.offsets[rows]
-        pos_in_row = jnp.arange(nbytes, dtype=jnp.int32) - row_first_byte
-        starts_i = match.astype(jnp.int32)
-        # covered[i] = any match start in (i-Ls, i] -> byte i is replaced.
-        csum = jnp.concatenate([jnp.zeros(1, dtype=jnp.int32),
-                                jnp.cumsum(starts_i)])
-        lo = jnp.maximum(jnp.arange(nbytes) - Ls + 1, 0)
-        covered = (csum[jnp.arange(nbytes) + 1] - csum[lo]) > 0
-        # Matches before byte i in the same row:
-        m_before = csum[jnp.arange(nbytes)]  # global matches strictly before i
-        m_before_row_start = csum[jnp.clip(row_first_byte, 0, nbytes)]
-        m_in_row_before = m_before - m_before_row_start
-        # Output position of each *copied* byte and each *match start*:
-        out_pos_copy = pos_in_row + m_in_row_before * (Lr - Ls)
-        # Build output via scatter of copied bytes, then scatter replacement
-        # bytes at match starts.
-        out_offsets = jnp.concatenate([
-            jnp.zeros(1, dtype=jnp.int32),
-            jnp.cumsum(new_lens).astype(jnp.int32)])
-        out_total = out_offsets[-1]
-        out_base = out_offsets[rows]
-        out_idx_copy = out_base + out_pos_copy
-        in_data_mask = jnp.arange(nbytes, dtype=jnp.int32) < v.offsets[-1]
-        valid_copy = in_data_mask & ~covered
-        out = jnp.zeros(out_cap, dtype=jnp.uint8)
-        out = out.at[jnp.where(valid_copy, out_idx_copy, out_cap)].set(
-            v.data, mode="drop")
-        # match starts: the match at input pos i (m_in_row_before matches
-        # before it) maps to output position pos_in_row + m_in_row_before*(Lr-Ls)
-        out_idx_match = out_base + pos_in_row + m_in_row_before * (Lr - Ls)
-        for k, bch in enumerate(repl):
-            out = out.at[jnp.where(match & in_data_mask, out_idx_match + k,
-                                   out_cap)].set(
-                jnp.full(nbytes, bch, dtype=jnp.uint8), mode="drop")
-        return DevVal(T.STRING, out, v.validity, out_offsets)
+        return _replace_match_starts(v, match, len(search), repl, ctx)
 
     def cpu_eval(self, ctx) -> CpuVal:
         v = self.children[0].cpu_eval(ctx)
@@ -771,3 +779,311 @@ class StringLPad(_Pad):
 
 class StringRPad(_Pad):
     _left = False
+
+
+# ---------------------------------------------------------------------------
+# regexp_replace / split_part / concat_ws
+# (reference: stringFunctions.scala GpuRegExpReplace/GpuStringSplit/
+#  GpuConcatWs; the reference likewise transpiles or rejects regex patterns —
+#  RegexParser in RegexParser.scala)
+# ---------------------------------------------------------------------------
+
+_REGEX_META = set(".^$*+?()[]{}|\\")
+
+
+def _regex_as_literal(pattern: str) -> Optional[str]:
+    """The literal string a regex matches exactly, or None if it uses any
+    unescaped metacharacter (conservative transpile, like the reference's
+    RegexParser rejecting what cudf can't run)."""
+    out = []
+    i = 0
+    while i < len(pattern):
+        ch = pattern[i]
+        if ch == "\\":
+            if i + 1 >= len(pattern):
+                return None
+            nxt = pattern[i + 1]
+            if nxt in _REGEX_META:
+                out.append(nxt)
+                i += 2
+                continue
+            return None  # \d, \s ... not a literal
+        if ch in _REGEX_META:
+            return None
+        out.append(ch)
+        i += 1
+    return "".join(out)
+
+
+def _regex_as_byte_class(pattern: str) -> Optional[bytes]:
+    """The set of single bytes a regex char-class matches, or None.
+
+    Supports ``[abc]`` and ``[a-z0-9]`` style classes over ASCII (no
+    negation, no nested escapes beyond ``\\]``-type literals).
+    """
+    if len(pattern) < 3 or pattern[0] != "[" or pattern[-1] != "]":
+        return None
+    inner = pattern[1:-1]
+    if inner.startswith("^"):
+        return None
+    members = set()
+    i = 0
+    while i < len(inner):
+        ch = inner[i]
+        if ch == "\\":
+            if i + 1 >= len(inner):
+                return None
+            ch = inner[i + 1]
+            if ch not in _REGEX_META and ch != "-":
+                return None
+            i += 2
+        elif i + 2 < len(inner) and inner[i + 1] == "-":
+            lo, hi = ord(inner[i]), ord(inner[i + 2])
+            if lo > hi or hi > 127:
+                return None
+            members.update(chr(c) for c in range(lo, hi + 1))
+            i += 3
+            continue
+        else:
+            i += 1
+        if ord(ch) > 127:
+            return None
+        members.add(ch)
+    if not members:
+        return None
+    return bytes(sorted(ord(c) for c in members))
+
+
+class RegExpReplace(Expression):
+    """regexp_replace(str, pattern, replacement).
+
+    TPU path covers the subset the engine can transpile: patterns that are
+    plain literals (after unescaping) reuse the StringReplace kernel, and
+    single-char classes like ``[0-9]`` map each member byte.  Everything
+    else (real regex) falls back to the CPU engine's ``re`` evaluation —
+    the same accept/reject shape as the reference's RegexParser
+    (stringFunctions.scala:862 + RegexParser).
+    """
+
+    def __init__(self, child: Expression, pattern: Expression,
+                 replacement: Expression):
+        if not isinstance(pattern, Expression):
+            pattern = Literal(str(pattern), T.STRING)
+        if not isinstance(replacement, Expression):
+            replacement = Literal(str(replacement), T.STRING)
+        self.children = (child, pattern, replacement)
+        self.dtype = T.STRING
+        self.nullable = child.nullable
+
+    def with_children(self, children):
+        return RegExpReplace(*children)
+
+    def _plan(self):
+        """("literal", s) | ("class", bytes) | None."""
+        pat = _literal_needle(self.children[1])
+        if pat is None or _literal_needle(self.children[2]) is None:
+            return None
+        lit = _regex_as_literal(pat)
+        if lit is not None and lit != "":
+            return ("literal", lit)
+        cls = _regex_as_byte_class(pat)
+        if cls is not None:
+            return ("class", cls)
+        return None
+
+    def tpu_supported(self, conf):
+        plan = self._plan()
+        if plan is None:
+            return ("regexp pattern is not in the transpilable subset "
+                    "(literal or single-char class); CPU fallback")
+        if plan[0] == "literal" and \
+                _has_self_overlap(plan[1].encode("utf-8")):
+            return ("regexp literal can self-overlap; sequential matching "
+                    "required (CPU only)")
+        return None
+
+    def tpu_eval(self, ctx) -> DevVal:
+        kind, what = self._plan()
+        v = self.children[0].tpu_eval(ctx)
+        repl = _literal_needle(self.children[2]).encode("utf-8")
+        if kind == "literal":
+            match = _find_matches(v, what.encode("utf-8"))
+            return _replace_match_starts(v, match,
+                                         len(what.encode("utf-8")),
+                                         repl, ctx)
+        # char class: every member byte is a length-1 match
+        nbytes = int(v.data.shape[0])
+        match = jnp.zeros(nbytes, dtype=jnp.bool_)
+        for b in what:
+            match = match | (v.data == np.uint8(b))
+        in_data = jnp.arange(nbytes, dtype=jnp.int32) < v.offsets[-1]
+        return _replace_match_starts(v, match & in_data, 1, repl, ctx)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        import re
+        v = self.children[0].cpu_eval(ctx)
+        pat = str(_literal_needle(self.children[1]) or "")
+        repl = str(_literal_needle(self.children[2]) or "")
+        rx = re.compile(pat)
+        out = np.array([rx.sub(repl, str(s)) for s in v.values],
+                       dtype=object)
+        return CpuVal(T.STRING, out, v.validity)
+
+
+class SplitPart(Expression):
+    """split_part(str, delimiter, partNum): 1-based field extraction on a
+    literal delimiter; out-of-range -> empty string (Spark split_part /
+    the getItem(i) shape of GpuStringSplit, stringFunctions.scala)."""
+
+    def __init__(self, child: Expression, delimiter, part):
+        if not isinstance(delimiter, Expression):
+            delimiter = Literal(str(delimiter), T.STRING)
+        self.children = (child, delimiter)
+        self.part = int(part)
+        self.dtype = T.STRING
+        self.nullable = child.nullable
+
+    def with_children(self, children):
+        return SplitPart(children[0], children[1], self.part)
+
+    def tpu_supported(self, conf):
+        d = _literal_needle(self.children[1])
+        if d is None or d == "":
+            return "split delimiter must be a non-empty literal"
+        if self.part < 1:
+            return "negative/zero part numbers run on CPU"
+        if _has_self_overlap(d.encode("utf-8")):
+            return "split delimiter can self-overlap (CPU only)"
+        return None
+
+    def tpu_eval(self, ctx) -> DevVal:
+        v = self.children[0].tpu_eval(ctx)
+        delim = _literal_needle(self.children[1]).encode("utf-8")
+        Ld = len(delim)
+        j = self.part - 1  # 0-based part index
+        cap = v.capacity
+        nbytes = int(v.data.shape[0])
+        match = _find_matches(v, delim)
+        rows = jnp.clip(rows_of_positions(v.offsets, nbytes), 0, cap - 1)
+        pos = jnp.arange(nbytes, dtype=jnp.int32)
+        starts_i = match.astype(jnp.int32)
+        csum = jnp.concatenate([jnp.zeros(1, dtype=jnp.int32),
+                                jnp.cumsum(starts_i)])
+        rank = csum[pos] - csum[jnp.clip(v.offsets[rows], 0, nbytes)]
+        big = jnp.int32(1 << 30)
+        # in-row byte position of the (j-1)-th and j-th delimiter match
+        def match_pos(k):
+            sel = match & (rank == k)
+            return jax.ops.segment_min(
+                jnp.where(sel, pos, big), rows, num_segments=cap)
+
+        n_matches = jax.ops.segment_sum(starts_i, rows, num_segments=cap)
+        row_start = v.offsets[:-1]
+        row_end = v.offsets[1:]
+        start = row_start if j == 0 else \
+            jnp.minimum(match_pos(j - 1) + Ld, row_end)
+        end = jnp.where(n_matches > j, match_pos(j), row_end)
+        exists = n_matches >= j  # part j exists when >= j delimiters... 
+        # parts = n_matches + 1, so part index j valid iff j <= n_matches
+        new_lens = jnp.where(exists, jnp.maximum(end - start, 0), 0)
+        new_lens = jnp.where(v.validity & ctx.row_mask, new_lens, 0)
+        rel_start = (start - row_start).astype(jnp.int32)
+        return _gather_substring(v, rel_start, new_lens, nbytes, v.validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        v = self.children[0].cpu_eval(ctx)
+        d = str(_literal_needle(self.children[1]) or "")
+        out = np.empty(len(v.values), dtype=object)
+        for i, s in enumerate(v.values):
+            parts = str(s).split(d) if d else [str(s)]
+            k = self.part
+            if k < 0:
+                k = len(parts) + k + 1
+            out[i] = parts[k - 1] if 1 <= k <= len(parts) else ""
+        return CpuVal(T.STRING, out, v.validity)
+
+
+class ConcatWs(Expression):
+    """concat_ws(sep, cols...): join non-NULL values with a literal
+    separator; NULL inputs are skipped (never nullify the result)."""
+
+    def __init__(self, sep, *children: Expression):
+        self.sep = str(sep)
+        self.children = tuple(children)
+        self.dtype = T.STRING
+        self.nullable = False
+
+    def with_children(self, children):
+        return ConcatWs(self.sep, *children)
+
+    def tpu_supported(self, conf):
+        for c in self.children:
+            if not c.dtype.is_string:
+                return f"concat_ws child must be string, got {c.dtype}"
+        return None
+
+    def tpu_eval(self, ctx) -> DevVal:
+        sep = self.sep.encode("utf-8")
+        Lsep = len(sep)
+        sep_arr = jnp.asarray(np.frombuffer(sep, dtype=np.uint8)) \
+            if Lsep else jnp.zeros(1, dtype=jnp.uint8)
+        vals = [c.tpu_eval(ctx) for c in self.children]
+        cap = ctx.capacity
+        acc = vals[0]
+        # normalize: null -> empty, track has_any
+        l0 = jnp.where(acc.validity, string_lengths(acc), 0)
+        acc = DevVal(T.STRING,
+                     acc.data,
+                     jnp.ones(cap, dtype=jnp.bool_),
+                     jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                      jnp.cumsum(jnp.where(
+                                          ctx.row_mask, l0, 0)).astype(
+                                              jnp.int32)]))
+        # rebuild acc bytes for the masked lens (drop bytes of null rows)
+        acc = _gather_substring(
+            DevVal(T.STRING, vals[0].data, vals[0].validity,
+                   vals[0].offsets),
+            jnp.zeros(cap, dtype=jnp.int32),
+            jnp.where(vals[0].validity & ctx.row_mask, l0, 0),
+            int(vals[0].data.shape[0]),
+            jnp.ones(cap, dtype=jnp.bool_))
+        has_any = vals[0].validity
+        for v in vals[1:]:
+            la = string_lengths(acc)
+            lv = jnp.where(v.validity, string_lengths(v), 0)
+            add_sep = has_any & v.validity
+            new_lens = la + jnp.where(v.validity,
+                                      lv + jnp.where(add_sep, Lsep, 0), 0)
+            new_lens = jnp.where(ctx.row_mask, new_lens, 0)
+            na, nv = int(acc.data.shape[0]), int(v.data.shape[0])
+            a_base, v_base = acc.offsets[:-1], v.offsets[:-1]
+            sep_start = la  # in-row position where separator begins
+            v_start = la + jnp.where(add_sep, Lsep, 0)
+
+            def src(rows, pos, acc=acc, v=v, la=la, sep_start=sep_start,
+                    v_start=v_start, na=na, nv=nv, a_base=a_base,
+                    v_base=v_base):
+                from_a = pos < la[rows]
+                in_sep = (~from_a) & (pos < v_start[rows])
+                ia = jnp.clip(a_base[rows] + pos, 0, na - 1)
+                iv = jnp.clip(v_base[rows] + pos - v_start[rows], 0, nv - 1)
+                isep = jnp.clip(pos - sep_start[rows], 0,
+                                max(Lsep - 1, 0))
+                return jnp.where(
+                    from_a, acc.data[ia],
+                    jnp.where(in_sep, sep_arr[isep], v.data[iv]))
+
+            out_cap = na + nv + (cap * Lsep if Lsep else 0)
+            acc = build_string(T.STRING, new_lens, src, out_cap,
+                               jnp.ones(cap, dtype=jnp.bool_))
+            has_any = has_any | v.validity
+        return acc
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        vals = [c.cpu_eval(ctx) for c in self.children]
+        n = ctx.num_rows
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            pieces = [str(v.values[i]) for v in vals if v.validity[i]]
+            out[i] = self.sep.join(pieces)
+        return CpuVal(T.STRING, out, np.ones(n, dtype=np.bool_))

@@ -192,6 +192,79 @@ def substring(c, pos: int, length: int) -> Column:
     return Column(Substring(e, pos, length))
 
 
+def regexp_replace(c, pattern: str, replacement: str) -> Column:
+    from spark_rapids_tpu.exprs.strings import RegExpReplace
+    e = _to_expr(col(c) if isinstance(c, str) else c)
+    return Column(RegExpReplace(e, pattern, replacement))
+
+
+def replace(c, search: str, replacement: str) -> Column:
+    from spark_rapids_tpu.exprs.strings import StringReplace
+    e = _to_expr(col(c) if isinstance(c, str) else c)
+    return Column(StringReplace(e, search, replacement))
+
+
+def split_part(c, delimiter: str, part: int) -> Column:
+    """1-based field extraction on a literal delimiter (Spark split_part /
+    split(col, d).getItem(part-1))."""
+    from spark_rapids_tpu.exprs.strings import SplitPart
+    e = _to_expr(col(c) if isinstance(c, str) else c)
+    return Column(SplitPart(e, delimiter, part))
+
+
+def concat_ws(sep: str, *cols) -> Column:
+    from spark_rapids_tpu.exprs.strings import ConcatWs
+    return Column(ConcatWs(sep, *[_to_expr(
+        col(c) if isinstance(c, str) else c) for c in cols]))
+
+
+def shiftleft(c, n) -> Column:
+    from spark_rapids_tpu.exprs.bitwise import ShiftLeft
+    e = _to_expr(col(c) if isinstance(c, str) else c)
+    return Column(ShiftLeft(e, _to_expr(n)))
+
+
+def shiftright(c, n) -> Column:
+    from spark_rapids_tpu.exprs.bitwise import ShiftRight
+    e = _to_expr(col(c) if isinstance(c, str) else c)
+    return Column(ShiftRight(e, _to_expr(n)))
+
+
+def shiftrightunsigned(c, n) -> Column:
+    from spark_rapids_tpu.exprs.bitwise import ShiftRightUnsigned
+    e = _to_expr(col(c) if isinstance(c, str) else c)
+    return Column(ShiftRightUnsigned(e, _to_expr(n)))
+
+
+def bitwise_not(c) -> Column:
+    from spark_rapids_tpu.exprs.bitwise import BitwiseNot
+    return _unary(BitwiseNot, c)
+
+
+bitwiseNOT = bitwise_not
+
+
+def _bitwise_col(self: Column, other, cls_name: str) -> Column:
+    from spark_rapids_tpu.exprs import bitwise as B
+    return Column(getattr(B, cls_name)(self.expr, _to_expr(other)))
+
+
+Column.bitwiseAND = lambda self, o: _bitwise_col(self, o, "BitwiseAnd")
+Column.bitwiseOR = lambda self, o: _bitwise_col(self, o, "BitwiseOr")
+Column.bitwiseXOR = lambda self, o: _bitwise_col(self, o, "BitwiseXor")
+
+
+def unix_timestamp(c) -> Column:
+    from spark_rapids_tpu.exprs.datetime import UnixTimestamp
+    return _unary(UnixTimestamp, c)
+
+
+def from_unixtime(c, fmt: str = "yyyy-MM-dd HH:mm:ss") -> Column:
+    from spark_rapids_tpu.exprs.datetime import FromUnixTime
+    e = _to_expr(col(c) if isinstance(c, str) else c)
+    return Column(FromUnixTime(e, fmt))
+
+
 def year(c) -> Column:
     from spark_rapids_tpu.exprs.datetime import Year
     return _unary(Year, c)

@@ -119,3 +119,73 @@ def run_distributed_agg_demo(n_devices: int, rows_per_device: int = 256,
                 int(gs_h[d, i])
     assert got == expect, f"distributed agg mismatch: {got} != {expect}"
     return {"devices": n, "groups": len(got), "rows": int(num_rows.sum())}
+
+
+def run_distributed_query_demo(n_devices: int, n_rows: int = 4000) -> dict:
+    """Execute a PLANNER-BUILT query (string group key included) with the
+    mesh all-to-all as the engine's shuffle, and verify against a pure-CPU
+    oracle session.
+
+    This is the engine-level multi-chip path: TpuShuffleExchangeExec sees a
+    >1-device mesh (spark.rapids.shuffle.ici.enabled) and routes the hash
+    exchange through ``mesh_shuffle.mesh_exchange_batches`` — the analogue
+    of running a real query through the reference's RapidsShuffleManager
+    (RapidsShuffleInternalManager.scala:91-154) instead of Spark's fallback
+    shuffle.  Requires the default platform to provide ``n_devices``
+    devices (the dryrun subprocess forces CPU + device_count).
+    """
+    import jax
+    from spark_rapids_tpu import functions as F
+    from spark_rapids_tpu.session import TpuSparkSession
+
+    assert len(jax.devices()) >= n_devices, \
+        f"need {n_devices} devices, have {len(jax.devices())}"
+
+    cats = ["alpha", "beta", "gamma", "delta", None,
+            "a-much-longer-category-name"]
+    rng = np.random.RandomState(11)
+    cat = [cats[i] for i in rng.randint(0, len(cats), n_rows)]
+    qty = rng.randint(1, 100, n_rows).astype(np.int64)
+    price = (rng.rand(n_rows) * 50).round(3)
+
+    def build(sess):
+        df = sess.create_dataframe(
+            {"cat": list(cat), "qty": qty.tolist(),
+             "price": price.tolist()},
+            num_partitions=6)
+        return (df.filter(F.col("qty") > 10)
+                  .group_by("cat")
+                  .agg(F.sum(F.col("qty")).alias("s"),
+                       F.count(F.col("qty")).alias("c"),
+                       F.avg(F.col("price")).alias("a")))
+
+    tpu = (TpuSparkSession.builder()
+           .config("spark.rapids.shuffle.ici.enabled", True)
+           .config("spark.rapids.sql.variableFloatAgg.enabled", True)
+           .config("spark.sql.shuffle.partitions", n_devices)
+           .get_or_create())
+    got_rows = build(tpu).collect()
+
+    mesh_ops = [op for op, ms in tpu.last_metrics.items()
+                if ms.get("meshExchanges")]
+    assert mesh_ops, \
+        f"no exchange took the mesh path; metrics={tpu.last_metrics}"
+
+    # oracle: plain python
+    expect = {}
+    for c, q, p in zip(cat, qty, price):
+        if q <= 10:
+            continue
+        s, n_, a = expect.get(c, (0, 0, 0.0))
+        expect[c] = (s + int(q), n_ + 1, a + float(p))
+    exp_rows = sorted(
+        ((k, s, n_, s_p / n_) for k, (s, n_, s_p) in expect.items()),
+        key=lambda r: (r[0] is None, str(r[0])))
+    got_sorted = sorted(got_rows, key=lambda r: (r[0] is None, str(r[0])))
+    assert len(exp_rows) == len(got_sorted), \
+        f"{len(exp_rows)} != {len(got_sorted)}"
+    for e, g in zip(exp_rows, got_sorted):
+        assert e[0] == g[0] and e[1] == g[1] and e[2] == g[2] and \
+            abs(e[3] - g[3]) < 1e-6, f"mismatch: {e} vs {g}"
+    return {"devices": n_devices, "groups": len(exp_rows),
+            "mesh_exchanges": len(mesh_ops)}

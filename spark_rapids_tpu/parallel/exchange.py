@@ -22,7 +22,8 @@ from spark_rapids_tpu.batch import (
 )
 from spark_rapids_tpu.kernels.layout import compact, gather_rows
 from spark_rapids_tpu.parallel.partitioning import (
-    Partitioning, RangePartitioning, SinglePartitioning,
+    HashPartitioning, Partitioning, RangePartitioning,
+    RoundRobinPartitioning, SinglePartitioning,
 )
 from spark_rapids_tpu.plan.physical import (
     CpuExec, ExecContext, PhysicalOp, TpuExec,
@@ -122,14 +123,20 @@ class TpuShuffleExchangeExec(TpuExec):
         self._input_fns = list(fns)
         self._fused_map = None
 
+    def _mesh_active(self, ctx) -> bool:
+        return getattr(ctx, "mesh", None) is not None
+
     def _collapse_local(self, ctx) -> bool:
-        return _collapse_local_conf(ctx)
+        return not self._mesh_active(ctx) and _collapse_local_conf(ctx)
 
     def describe(self):
         p = self.partitioning
         return f"TpuShuffleExchange({type(p).__name__}, {p.num_partitions})"
 
     def num_partitions(self, ctx):
+        if self._mesh_active(ctx):
+            from spark_rapids_tpu.parallel.mesh_shuffle import DATA_AXIS
+            return ctx.mesh.shape[DATA_AXIS]
         if self._collapse_local(ctx):
             return 1
         return self.partitioning.num_partitions
@@ -170,7 +177,61 @@ class TpuShuffleExchangeExec(TpuExec):
                     lens, ids, num_segments=n + 1)[:n])
         return sorted_batch, counts, byte_totals
 
+    def _mesh_partitions(self, ctx):
+        """ICI collective path: rows move between mesh devices with ONE
+        lax.all_to_all per column payload (the reference's UCX transport
+        role, RapidsShuffleTransport.scala:378-492, as a single compiled
+        SPMD program)."""
+        from spark_rapids_tpu.ops.tpu_exec import _concat_all
+        from spark_rapids_tpu.parallel.mesh_shuffle import (
+            DATA_AXIS, mesh_exchange_batches,
+        )
+        mesh = ctx.mesh
+        n = mesh.shape[DATA_AXIS]
+        batches: List[ColumnBatch] = []
+        for part in self.children[0].partitions(ctx):
+            batches.extend(part)
+        if self._input_fns:
+            if self._fused_map is None:
+                fns = list(self._input_fns)
+
+                def composed(b):
+                    for f in fns:
+                        b = f(b)
+                    return b
+
+                self._fused_map = jax.jit(composed)
+            batches = [self._fused_map(b) for b in batches]
+        if not batches:
+            return [iter([]) for _ in range(n)]
+        # re-key the partitioning onto the mesh: one output partition per
+        # device (preserves range ordering / hash co-location)
+        part = _mesh_partitioning(self.partitioning, n)
+        if isinstance(part, RangePartitioning):
+            part.prepare(_sample_device_keys([batches], part.key_ordinals))
+        per_dev: List[List[ColumnBatch]] = [[] for _ in range(n)]
+        for i, b in enumerate(batches):
+            per_dev[i % n].append(b)
+        local_batches, pids_list = [], []
+        for d in range(n):
+            merged = _concat_all(per_dev[d], self.output_schema)
+            if merged is None:
+                local_batches.append(None)
+                pids_list.append(None)
+                continue
+            pid = part.device_partition_ids(merged, d)
+            local_batches.append(merged)
+            pids_list.append(jnp.asarray(pid, jnp.int32))
+        out = mesh_exchange_batches(mesh, local_batches, pids_list,
+                                    self.output_schema)
+        ctx.metric(self.op_id, "meshExchanges").add(1)
+        ctx.metric(self.op_id, "meshDevices").add(n)
+        return [iter([b]) for b in out] if out else \
+            [iter([]) for _ in range(n)]
+
     def partitions(self, ctx):
+        if self._mesh_active(ctx):
+            return self._mesh_partitions(ctx)
         n = self.partitioning.num_partitions
         in_parts = self.children[0].partitions(ctx)
         if self._collapse_local(ctx):
@@ -233,6 +294,19 @@ class TpuShuffleExchangeExec(TpuExec):
                     out[p].append(piece)
                     offset += cnt
         return [iter(p) for p in out]
+
+
+def _mesh_partitioning(p: Partitioning, n: int) -> Partitioning:
+    """Clone a partitioning with num_partitions = mesh device count, so one
+    output partition maps to one device (range order and hash co-location
+    are preserved by re-keying, not by folding pids mod n)."""
+    if isinstance(p, HashPartitioning):
+        return HashPartitioning(p.keys, n)
+    if isinstance(p, RoundRobinPartitioning):
+        return RoundRobinPartitioning(n)
+    if isinstance(p, RangePartitioning):
+        return RangePartitioning(p.orders, p.key_ordinals, n)
+    return p  # SinglePartitioning
 
 
 def _sample_device_keys(all_batches: List[List[ColumnBatch]],

@@ -9,9 +9,17 @@ and is scheduled by XLA, no progress thread / bounce buffers needed.
 
 Layout contract: a *mesh batch* is a pytree of arrays whose leading axis is
 the mesh's ``data`` axis (one slice per device): data[N, cap], validity
-[N, cap], num_rows[N].  Strings are not yet supported on this path (they
-fall back to the host exchange) — the bucket padding story for varlen
-buffers lands with the native transport work.
+[N, cap], num_rows[N].  Strings ride the same collective as fixed-width
+columns by flattening each device's (offsets, bytes) pair into a padded
+``uint8[cap, maxlen]`` row matrix + ``int32[cap]`` lengths before the
+all-to-all, and rebuilding the offsets layout on the receive side — the
+TPU answer to the reference's bounce-buffer framing of varlen buffers
+(RapidsShuffleServer.scala:343-612).
+
+:func:`mesh_exchange_batches` is the engine-facing entry: it is what
+``TpuShuffleExchangeExec`` calls when a >1-device mesh is active
+(``spark.rapids.shuffle.ici.enabled``), making the collective the query
+plan's shuffle rather than a standalone demo.
 """
 
 from __future__ import annotations
@@ -104,17 +112,6 @@ def _compact_received(data_cols, validity_cols, counts, n: int, cap: int):
     return out_data, out_valid, total.astype(jnp.int32)
 
 
-def all_to_all_exchange(mesh: Mesh, data_cols, validity_cols, num_rows,
-                        pids):
-    """SPMD row exchange: every row moves to the device ``pids`` names.
-
-    Inputs are mesh-sharded: data_cols/validity_cols [N*cap] sharded on the
-    leading axis? No — this function is built to be called INSIDE shard_map
-    with per-device locals; see :func:`make_exchange_fn` for the wrapper.
-    """
-    raise NotImplementedError("use make_exchange_fn")
-
-
 def make_exchange_fn(mesh: Mesh, n_cols: int, cap: int):
     """Build a jittable SPMD function exchanging rows by partition id.
 
@@ -154,3 +151,234 @@ def make_exchange_fn(mesh: Mesh, n_cols: int, cap: int):
                  P(DATA_AXIS))
     return jax.jit(shard_map(spmd, mesh=mesh, in_specs=in_specs,
                              out_specs=out_specs))
+
+
+# --------------------------------------------------------------------------
+# Engine-facing batch exchange (strings included)
+# --------------------------------------------------------------------------
+#
+# A ColumnBatch is lowered to a flat list of *payload* arrays, each with the
+# row index as the leading axis:
+#   fixed col   -> data[cap], validity[cap]
+#   string col  -> bytes uint8[cap, maxlen], lengths int32[cap],
+#                  validity[cap]
+# One shard_map program buckets rows by destination device, runs ONE
+# lax.all_to_all per payload over ICI, and compacts the n received buckets
+# into a single local batch of capacity n*cap.  Row-major payloads mean the
+# string bytes move on the same collective as the data — no separate varlen
+# protocol.
+
+
+def make_payload_exchange_fn(mesh: Mesh, ndims: Tuple[int, ...], cap: int):
+    """Build the jitted SPMD exchange over arbitrary row-payload arrays.
+
+    ``ndims[i]`` is the per-device rank of payload i (1 for [cap] vectors,
+    2 for [cap, maxlen] byte matrices).  The returned fn maps
+    (payloads [N, cap, ...], num_rows [N], pids [N, cap]) ->
+    (payloads [N, N*cap, ...], counts [N]).
+    """
+    n = mesh.shape[DATA_AXIS]
+
+    def spmd(payloads, num_rows, pids):
+        pls = [p[0] for p in payloads]
+        nr = num_rows[0]
+        pid = pids[0]
+        live = jnp.arange(cap, dtype=jnp.int32) < nr
+        pid = jnp.where(live, pid, n)  # padding rows -> dead bucket
+        order = jnp.argsort(pid, stable=True).astype(jnp.int32)
+        sorted_pid = pid[order]
+        counts = jnp.zeros(n + 1, jnp.int32).at[sorted_pid].add(
+            1, mode="drop")[:n]
+        starts = jnp.concatenate([
+            jnp.zeros(1, jnp.int32),
+            jnp.cumsum(counts).astype(jnp.int32)[:-1]])
+        j_idx = jnp.arange(cap, dtype=jnp.int32)[None, :]
+        src = jnp.clip(starts[:, None] + j_idx, 0, cap - 1)
+        in_bucket = j_idx < counts[:, None]
+        rows = order[src]  # [n, cap] source row per (dest bucket, slot)
+        bucketed = []
+        for p in pls:
+            g = p[rows]  # [n, cap, ...trailing]
+            mask = in_bucket.reshape(in_bucket.shape +
+                                     (1,) * (g.ndim - 2))
+            bucketed.append(jnp.where(mask, g, jnp.zeros((), g.dtype)))
+        recv = [jax.lax.all_to_all(b, DATA_AXIS, 0, 0, tiled=False)
+                for b in bucketed]
+        r_counts = jax.lax.all_to_all(counts, DATA_AXIS, 0, 0, tiled=False)
+        # compact the n received buckets into one local run of rows
+        out_cap = n * cap
+        flat = jnp.arange(out_cap, dtype=jnp.int32)
+        cum = jnp.cumsum(r_counts)
+        starts2 = cum - r_counts
+        bucket = jnp.searchsorted(cum, flat, side="right").astype(jnp.int32)
+        bucket_c = jnp.clip(bucket, 0, n - 1)
+        within = jnp.clip(flat - starts2[bucket_c], 0, cap - 1)
+        total = jnp.sum(r_counts).astype(jnp.int32)
+        live_o = flat < total
+        outs = []
+        for r in recv:
+            g = r[bucket_c, within]  # [out_cap, ...trailing]
+            mask = live_o.reshape(live_o.shape + (1,) * (g.ndim - 1))
+            outs.append(jnp.where(mask, g, jnp.zeros((), g.dtype)))
+        return [o[None] for o in outs], total[None]
+
+    from jax import shard_map
+    in_specs = ([P(DATA_AXIS, *([None] * nd)) for nd in ndims],
+                P(DATA_AXIS), P(DATA_AXIS, None))
+    out_specs = ([P(DATA_AXIS, *([None] * nd)) for nd in ndims],
+                 P(DATA_AXIS))
+    return jax.jit(shard_map(spmd, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs))
+
+
+_exchange_fn_cache: dict = {}
+
+
+def _cached_payload_exchange_fn(mesh: Mesh, ndims: Tuple[int, ...],
+                                cap: int):
+    key = (mesh, ndims, cap)
+    fn = _exchange_fn_cache.get(key)
+    if fn is None:
+        fn = make_payload_exchange_fn(mesh, ndims, cap)
+        _exchange_fn_cache[key] = fn
+    return fn
+
+
+@functools.partial(jax.jit, static_argnames=("byte_cap",))
+def _padded_to_flat(mat, lens, byte_cap: int):
+    """Rebuild the cudf (offsets, flat bytes) layout from a padded byte
+    matrix: one cumsum + one searchsorted-driven gather."""
+    out_cap, maxlen = int(mat.shape[0]), int(mat.shape[1])
+    offsets = jnp.concatenate([
+        jnp.zeros(1, jnp.int32),
+        jnp.cumsum(lens).astype(jnp.int32)])
+    j = jnp.arange(byte_cap, dtype=jnp.int32)
+    row = jnp.searchsorted(offsets[1:], j, side="right").astype(jnp.int32)
+    row_c = jnp.clip(row, 0, out_cap - 1)
+    within = jnp.clip(j - offsets[row_c], 0, max(maxlen - 1, 0))
+    data = jnp.where(j < offsets[-1], mat[row_c, within], 0).astype(jnp.uint8)
+    return data, offsets
+
+
+def mesh_exchange_batches(mesh: Mesh, local_batches, pids_list,
+                          schema) -> List[ColumnBatch]:
+    """Exchange rows of per-device batches so every row lands on the device
+    its pid names — the engine's accelerated shuffle.
+
+    ``local_batches``: one ColumnBatch (or None) per mesh device.
+    ``pids_list``: per-batch int32[cap] destination device ids in [0, n).
+    Returns one ColumnBatch per device with capacity n*cap_common; output
+    ``num_rows`` stays a device scalar (no host sync on this path).
+    """
+    from spark_rapids_tpu.batch import round_up_capacity
+    n = mesh.shape[DATA_AXIS]
+    assert len(local_batches) == n and len(pids_list) == n
+    present = [i for i, b in enumerate(local_batches) if b is not None]
+    if not present:
+        return []
+
+    # one bulk fetch of every raw buffer (+ pids) — single round trip
+    fetch = []
+    for i in present:
+        b = local_batches[i]
+        fetch.append((b.num_rows, pids_list[i],
+                      [(c.data, c.validity, c.offsets) if c.is_string
+                       else (c.data, c.validity) for c in b.columns]))
+    host = jax.device_get(fetch)
+
+    cap = round_up_capacity(max(max(int(h[0]) for h in host), 1))
+    str_cols = [i for i, f in enumerate(schema.fields) if f.dtype.is_string]
+    maxlens = {}
+    for ci in str_cols:
+        m = 1
+        for h in host:
+            nrows = int(h[0])
+            offs = np.asarray(h[2][ci][2])
+            if nrows:
+                m = max(m, int(np.max(offs[1:nrows + 1] - offs[:nrows])))
+        maxlens[ci] = round_up_capacity(m, minimum=8)
+
+    # build stacked [n, cap, ...] payloads on host
+    payload_np: List[np.ndarray] = []
+    ndims: List[int] = []
+    col_payload_slots = []  # per schema col: indices into payload list
+    for ci, f in enumerate(schema.fields):
+        if f.dtype.is_string:
+            ml = maxlens[ci]
+            col_payload_slots.append((len(payload_np),))
+            payload_np.append(np.zeros((n, cap, ml), dtype=np.uint8))
+            payload_np.append(np.zeros((n, cap), dtype=np.int32))
+            payload_np.append(np.zeros((n, cap), dtype=np.bool_))
+            ndims.extend([2, 1, 1])
+        else:
+            col_payload_slots.append((len(payload_np),))
+            payload_np.append(np.zeros((n, cap), dtype=f.dtype.np_dtype))
+            payload_np.append(np.zeros((n, cap), dtype=np.bool_))
+            ndims.extend([1, 1])
+    num_rows_np = np.zeros(n, dtype=np.int32)
+    pids_np = np.zeros((n, cap), dtype=np.int32)
+
+    for h, dev in zip(host, present):
+        nrows = int(h[0])
+        num_rows_np[dev] = nrows
+        if nrows == 0:
+            continue
+        pids_np[dev, :nrows] = np.asarray(h[1])[:nrows]
+        slot = 0
+        for ci, f in enumerate(schema.fields):
+            bufs = h[2][ci]
+            if f.dtype.is_string:
+                data = np.asarray(bufs[0])
+                valid = np.asarray(bufs[1])
+                offs = np.asarray(bufs[2]).astype(np.int64)
+                ml = maxlens[ci]
+                lens = (offs[1:nrows + 1] - offs[:nrows]).astype(np.int32)
+                idx = np.clip(offs[:nrows, None] +
+                              np.arange(ml, dtype=np.int64)[None, :],
+                              0, max(len(data) - 1, 0))
+                mask = np.arange(ml, dtype=np.int32)[None, :] < lens[:, None]
+                payload_np[slot][dev, :nrows] = np.where(
+                    mask, data[idx], 0)
+                payload_np[slot + 1][dev, :nrows] = lens
+                payload_np[slot + 2][dev, :nrows] = valid[:nrows]
+                slot += 3
+            else:
+                payload_np[slot][dev, :nrows] = np.asarray(bufs[0])[:nrows]
+                payload_np[slot + 1][dev, :nrows] = \
+                    np.asarray(bufs[1])[:nrows]
+                slot += 2
+
+    sh2 = NamedSharding(mesh, P(DATA_AXIS, None))
+    sh3 = NamedSharding(mesh, P(DATA_AXIS, None, None))
+    sh1 = NamedSharding(mesh, P(DATA_AXIS))
+    payloads = [jax.device_put(p, sh3 if p.ndim == 3 else sh2)
+                for p in payload_np]
+    d_rows = jax.device_put(num_rows_np, sh1)
+    d_pids = jax.device_put(pids_np, sh2)
+
+    fn = _cached_payload_exchange_fn(mesh, tuple(ndims), cap)
+    out_payloads, counts = fn(payloads, d_rows, d_pids)
+
+    out_cap = n * cap
+    out: List[ColumnBatch] = []
+    for d in range(n):
+        cols = []
+        slot = 0
+        for ci, f in enumerate(schema.fields):
+            if f.dtype.is_string:
+                ml = maxlens[ci]
+                byte_cap = round_up_capacity(max(out_cap * ml, 16),
+                                             minimum=16)
+                data, offsets = _padded_to_flat(
+                    out_payloads[slot][d], out_payloads[slot + 1][d],
+                    byte_cap)
+                cols.append(DeviceColumn(f.dtype, data,
+                                         out_payloads[slot + 2][d],
+                                         offsets))
+                slot += 3
+            else:
+                cols.append(DeviceColumn(f.dtype, out_payloads[slot][d],
+                                         out_payloads[slot + 1][d], None))
+                slot += 2
+        out.append(ColumnBatch(schema, cols, counts[d], out_cap))
+    return out

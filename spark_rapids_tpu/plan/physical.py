@@ -42,10 +42,15 @@ class Metric:
 class ExecContext:
     """Per-query execution context: conf, metrics, device admission."""
 
-    def __init__(self, conf: RapidsConf, semaphore=None, device=None):
+    def __init__(self, conf: RapidsConf, semaphore=None, device=None,
+                 mesh=None):
         self.conf = conf
         self.semaphore = semaphore
         self.device = device
+        # multi-device jax.sharding.Mesh when the ICI collective shuffle is
+        # active (spark.rapids.shuffle.ici.enabled + >1 device); exchanges
+        # then run lax.all_to_all instead of the single-host split
+        self.mesh = mesh
         self.metrics: Dict[str, Dict[str, Metric]] = {}
 
     def metric(self, op_id: str, name: str) -> Metric:

@@ -112,6 +112,23 @@ class TpuSparkSession:
         self.last_explain = overrides.last_explain
         return phys
 
+    def _shuffle_mesh(self):
+        """The >1-device mesh for the ICI collective shuffle, or None.
+
+        Opt-in via spark.rapids.shuffle.ici.enabled (the reference's
+        accelerated UCX shuffle is likewise explicitly configured:
+        RapidsShuffleManager in docs/get-started).  On a single-chip
+        process this is always None and exchanges use the host path.
+        """
+        if self.conf.get("spark.rapids.shuffle.ici.enabled", False) \
+                in (False, "false", None):
+            return None
+        if not hasattr(self, "_mesh"):
+            import jax
+            from spark_rapids_tpu.parallel.mesh_shuffle import make_mesh
+            self._mesh = make_mesh() if len(jax.devices()) > 1 else None
+        return self._mesh
+
     def execute(self, plan) -> HostBatch:
         from spark_rapids_tpu.plan.physical import ExecContext, collect_host
         phys = self.plan_physical(plan)
@@ -120,9 +137,15 @@ class TpuSparkSession:
         ctx = ExecContext(
             self.conf,
             semaphore=self.runtime.semaphore if self.runtime else None,
-            device=self.runtime.device if self.runtime else None)
+            device=self.runtime.device if self.runtime else None,
+            mesh=self._shuffle_mesh())
         self.last_physical_plan = phys
-        return collect_host(phys, ctx)
+        self.last_exec_ctx = ctx
+        out = collect_host(phys, ctx)
+        self.last_metrics = {
+            op: {name: m.value for name, m in ms.items()}
+            for op, ms in ctx.metrics.items()}
+        return out
 
     def explain_plan(self, plan) -> str:
         from spark_rapids_tpu.plan.overrides import TpuOverrides

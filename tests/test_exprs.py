@@ -261,3 +261,185 @@ class TestHash:
         data = {"k": (T.INT, [1])}
         out = run_both(Murmur3Hash(ColumnRef("k")), data)
         assert out[0] == -559580957
+
+
+class TestBitwise:
+    def test_and_or_xor(self):
+        from spark_rapids_tpu.exprs import BitwiseAnd, BitwiseOr, BitwiseXor
+        assert run_both(BitwiseAnd(ColumnRef("a"), ColumnRef("b")), INTS) == \
+            [0, 0, None, None, 1, 0, 7]
+        run_both(BitwiseOr(ColumnRef("a"), ColumnRef("b")), INTS)
+        run_both(BitwiseXor(ColumnRef("a"), ColumnRef("b")), INTS)
+
+    def test_not(self):
+        from spark_rapids_tpu.exprs import BitwiseNot
+        assert run_both(BitwiseNot(ColumnRef("a")), INTS) == \
+            [-2, -3, None, 3, -6, -1, -8]
+
+    def test_shifts(self):
+        from spark_rapids_tpu.exprs import (
+            ShiftLeft, ShiftRight, ShiftRightUnsigned,
+        )
+        data = {"v": (T.INT, [1, -8, None, 1 << 30, -1]),
+                "s": (T.INT, [3, 1, 2, 2, 1])}
+        assert run_both(ShiftLeft(ColumnRef("v"), ColumnRef("s")), data) == \
+            [8, -16, None, 0, -2]
+        assert run_both(ShiftRight(ColumnRef("v"), ColumnRef("s")), data) == \
+            [0, -4, None, 1 << 28, -1]
+        assert run_both(
+            ShiftRightUnsigned(ColumnRef("v"), ColumnRef("s")), data) == \
+            [0, 2147483644, None, 1 << 28, 2147483647]
+
+    def test_shift_amount_masked_java(self):
+        from spark_rapids_tpu.exprs import ShiftLeft
+        data = {"v": (T.INT, [1, 1]), "s": (T.INT, [33, 32])}
+        # java: s & 31 -> 1, 0
+        assert run_both(ShiftLeft(ColumnRef("v"), ColumnRef("s")), data) == \
+            [2, 1]
+
+    def test_long_shifts(self):
+        from spark_rapids_tpu.exprs import ShiftRightUnsigned
+        data = {"v": (T.LONG, [-1, 1 << 40]), "s": (T.INT, [1, 8])}
+        # java: -1L >>> 1 == Long.MAX_VALUE
+        assert run_both(
+            ShiftRightUnsigned(ColumnRef("v"), ColumnRef("s")), data) == \
+            [(1 << 63) - 1, 1 << 32]
+
+    def test_bitwise_fallback_on_strings(self):
+        from tests.compare import assert_tpu_cpu_equal
+        from spark_rapids_tpu import functions as F
+
+        def build(s):
+            df = s.create_dataframe({"a": [1, 2, 3], "b": [4, 5, 6]})
+            return df.select(F.col("a").bitwiseAND(F.col("b")))
+
+        assert_tpu_cpu_equal(build)
+
+
+class TestRegExpReplace:
+    def test_literal_pattern(self):
+        from spark_rapids_tpu.exprs import RegExpReplace
+        data = {"s": (T.STRING,
+                      ["hello", "ell", None, "bell bell", "", "no match"])}
+        assert run_both(
+            RegExpReplace(ColumnRef("s"), Literal("ell"), Literal("ELL")),
+            data) == ["hELLo", "ELL", None, "bELL bELL", "", "no match"]
+
+    def test_escaped_literal(self):
+        from spark_rapids_tpu.exprs import RegExpReplace
+        data = {"s": (T.STRING, ["a.b", "axb", "xa.b."])}
+        assert run_both(
+            RegExpReplace(ColumnRef("s"), Literal("a\\.b"), Literal("X")),
+            data) == ["X", "axb", "xX."]
+
+    def test_char_class(self):
+        from spark_rapids_tpu.exprs import RegExpReplace
+        data = {"s": (T.STRING, ["a1b22c333", "no digits", None, "9"])}
+        assert run_both(
+            RegExpReplace(ColumnRef("s"), Literal("[0-9]"), Literal("#")),
+            data) == ["a#b##c###", "no digits", None, "#"]
+
+    def test_char_class_delete(self):
+        from spark_rapids_tpu.exprs import RegExpReplace
+        data = {"s": (T.STRING, ["a-b_c", "--__"])}
+        assert run_both(
+            RegExpReplace(ColumnRef("s"), Literal("[-_]"), Literal("")),
+            data) == ["abc", ""]
+
+    def test_real_regex_falls_back(self):
+        from tests.compare import assert_tpu_cpu_equal
+        from spark_rapids_tpu import functions as F
+
+        def build(s):
+            df = s.create_dataframe({"s": ["foo12bar", "baz3", "qux"]})
+            return df.select(F.regexp_replace("s", r"\d+", "N"))
+
+        assert_tpu_cpu_equal(build, expect_fallback="RegExpReplace")
+
+
+class TestSplitPart:
+    def test_basic(self):
+        from spark_rapids_tpu.exprs import SplitPart
+        data = {"s": (T.STRING,
+                      ["a,b,c", "one", None, ",lead", "trail,", ""])}
+        assert run_both(SplitPart(ColumnRef("s"), ",", 1), data) == \
+            ["a", "one", None, "", "trail", ""]
+        assert run_both(SplitPart(ColumnRef("s"), ",", 2), data) == \
+            ["b", "", None, "lead", "", ""]
+        assert run_both(SplitPart(ColumnRef("s"), ",", 3), data) == \
+            ["c", "", None, "", "", ""]
+
+    def test_multichar_delim(self):
+        from spark_rapids_tpu.exprs import SplitPart
+        data = {"s": (T.STRING, ["a::b::c", "x::", "::"])}
+        assert run_both(SplitPart(ColumnRef("s"), "::", 2), data) == \
+            ["b", "", ""]
+
+    def test_negative_part_falls_back(self):
+        from tests.compare import assert_tpu_cpu_equal
+        from spark_rapids_tpu import functions as F
+
+        def build(s):
+            df = s.create_dataframe({"s": ["a,b,c", "x,y"]})
+            return df.select(F.split_part("s", ",", -1))
+
+        assert_tpu_cpu_equal(build, expect_fallback="SplitPart")
+
+
+class TestConcatWs:
+    def test_skips_nulls(self):
+        from spark_rapids_tpu.exprs import ConcatWs
+        data = {"s": (T.STRING, ["a", None, "c", None]),
+                "t": (T.STRING, ["x", "y", None, None])}
+        assert run_both(
+            ConcatWs("-", ColumnRef("s"), ColumnRef("t")), data) == \
+            ["a-x", "y", "c", ""]
+
+    def test_three_cols_empty_sep(self):
+        from spark_rapids_tpu.exprs import ConcatWs
+        data = {"s": (T.STRING, ["a", ""]), "t": (T.STRING, ["b", None]),
+                "u": (T.STRING, ["c", "z"])}
+        assert run_both(
+            ConcatWs("", ColumnRef("s"), ColumnRef("t"), ColumnRef("u")),
+            data) == ["abc", "z"]
+
+    def test_multibyte_sep(self):
+        from spark_rapids_tpu.exprs import ConcatWs
+        data = {"s": (T.STRING, ["a", "hello"]),
+                "t": (T.STRING, ["b", "world"])}
+        assert run_both(
+            ConcatWs(" :: ", ColumnRef("s"), ColumnRef("t")), data) == \
+            ["a :: b", "hello :: world"]
+
+
+class TestUnixTime:
+    def test_unix_timestamp_roundtrip(self):
+        from spark_rapids_tpu.exprs import FromUnixTime, UnixTimestamp
+        secs = [0, 1_600_000_000, None, 86_399, 2_000_000_000]
+        data = {"ts": (T.TIMESTAMP,
+                       [None if s is None else s * 1_000_000
+                        for s in secs])}
+        assert run_both(UnixTimestamp(ColumnRef("ts")), data) == secs
+
+    def test_unix_timestamp_date(self):
+        from spark_rapids_tpu.exprs import UnixTimestamp
+        data = {"d": (T.DATE, [0, 1, 18000, None])}
+        assert run_both(UnixTimestamp(ColumnRef("d")), data) == \
+            [0, 86400, 18000 * 86400, None]
+
+    def test_from_unixtime_default_format(self):
+        from spark_rapids_tpu.exprs import FromUnixTime
+        data = {"s": (T.LONG, [0, 1_600_000_000, None, 86_399])}
+        assert run_both(FromUnixTime(ColumnRef("s")), data) == \
+            ["1970-01-01 00:00:00", "2020-09-13 12:26:40", None,
+             "1970-01-01 23:59:59"]
+
+    def test_from_unixtime_custom_format_falls_back(self):
+        from tests.compare import assert_tpu_cpu_equal
+        from spark_rapids_tpu import functions as F
+
+        def build(s):
+            df = s.create_dataframe({"s": [0, 1_600_000_000]})
+            return df.select(F.from_unixtime("s", "yyyy/MM/dd"))
+
+        assert_tpu_cpu_equal(build, expect_fallback="FromUnixTime")

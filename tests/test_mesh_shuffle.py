@@ -47,3 +47,99 @@ def test_distributed_agg_demo_8dev():
     stats = run_distributed_agg_demo(8, rows_per_device=128)
     assert stats["devices"] == 8
     assert stats["groups"] == 17
+
+
+# ---------------------------------------------------------------------------
+# Engine-level mesh shuffle: planner-built queries whose exchanges run the
+# ICI all-to-all collective (spark.rapids.shuffle.ici.enabled).
+# ---------------------------------------------------------------------------
+
+from tests.compare import assert_tpu_cpu_equal, tpu_session  # noqa: E402
+from spark_rapids_tpu import functions as F  # noqa: E402
+
+MESH_CONFS = {"spark.rapids.shuffle.ici.enabled": True,
+              "spark.rapids.sql.variableFloatAgg.enabled": True}
+
+
+def _people_df(sess, n=500, parts=5):
+    cats = ["red", "green", "blue", None, "a-very-long-color-name-x", ""]
+    rng = np.random.RandomState(3)
+    return sess.create_dataframe({
+        "name": [cats[i] for i in rng.randint(0, len(cats), n)],
+        "age": rng.randint(0, 90, n).tolist(),
+        "score": (rng.rand(n) * 10).round(4).tolist(),
+    }, num_partitions=parts)
+
+
+def _assert_mesh_used(sess):
+    ops = [op for op, ms in sess.last_metrics.items()
+           if ms.get("meshExchanges")]
+    assert ops, f"no mesh exchange ran: {sess.last_metrics}"
+
+
+def test_mesh_groupby_string_key():
+    assert_tpu_cpu_equal(
+        lambda s: _people_df(s).group_by("name").agg(
+            F.sum(F.col("age")), F.count(F.col("age")),
+            F.avg(F.col("score"))),
+        approx=True, confs=MESH_CONFS)
+    sess = tpu_session(**MESH_CONFS)
+    _people_df(sess).group_by("name").agg(F.sum(F.col("age"))).collect()
+    _assert_mesh_used(sess)
+
+
+def test_mesh_shuffled_join():
+    def build(s):
+        left = _people_df(s, n=300, parts=4)
+        right = s.create_dataframe({
+            "name": ["red", "green", "blue", None, "missing"],
+            "bonus": [1, 2, 3, 4, 5],
+        }, num_partitions=2)
+        # big threshold=0 disables broadcast so the shuffled path runs
+        return left.join(right, on="name", how="inner")
+
+    assert_tpu_cpu_equal(
+        build, confs={**MESH_CONFS,
+                      "spark.sql.autoBroadcastJoinThreshold": 0})
+    sess = tpu_session(**MESH_CONFS,
+                       **{"spark.sql.autoBroadcastJoinThreshold": 0})
+    build(sess).collect()
+    _assert_mesh_used(sess)
+
+
+def test_mesh_global_sort_ordering():
+    # range partitioning over the mesh must preserve total order across
+    # device partitions (partition d's keys < partition d+1's)
+    assert_tpu_cpu_equal(
+        lambda s: _people_df(s, n=400).sort(
+            F.col("age").asc(), F.col("name").asc()),
+        approx=True, ignore_order=False, confs=MESH_CONFS)
+
+
+def test_mesh_repartition_roundrobin():
+    assert_tpu_cpu_equal(
+        lambda s: _people_df(s, n=200).repartition(6).select("age"),
+        confs=MESH_CONFS, ignore_order=True)
+
+
+def test_mesh_distinct():
+    assert_tpu_cpu_equal(
+        lambda s: _people_df(s, n=300).select("name").distinct(),
+        confs=MESH_CONFS)
+
+
+def test_mesh_strings_survive_roundtrip():
+    # empty strings, NULLs and long strings through the padded-matrix
+    # all-to-all layout
+    sess = tpu_session(**MESH_CONFS)
+    vals = ["", None, "x" * 100, "short", "ünïcødé-ÿ", "tail"] * 20
+    df = sess.create_dataframe(
+        {"s": vals, "v": list(range(len(vals)))}, num_partitions=4)
+    out = df.group_by("s").agg(F.count(F.col("v")))
+    rows = sorted(out.collect(), key=lambda r: (r[0] is None, str(r[0])))
+    expect = {}
+    for s in vals:
+        expect[s] = expect.get(s, 0) + 1
+    exp = sorted(expect.items(), key=lambda r: (r[0] is None, str(r[0])))
+    assert [(a, b) for a, b in rows] == exp
+    _assert_mesh_used(sess)
