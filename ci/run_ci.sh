@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# CI pipeline (SURVEY.md section 4.6 analogue of the reference's
+# jenkins/ + github-actions workflows): unit + integration tests on the
+# virtual 8-device CPU mesh, entry-point compile checks, multichip dryrun.
+#
+# Usage: ci/run_ci.sh [quick|full]
+#   quick: kernel + expression + e2e suites only
+#   full (default): whole suite + graft entry + 8-device dryrun
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+MODE="${1:-full}"
+
+echo "== python/jax versions"
+python - << 'PY'
+import sys, jax
+print(sys.version.split()[0], "jax", jax.__version__)
+PY
+
+if [ "$MODE" = "quick" ]; then
+  python -m pytest tests/test_kernels_layout.py tests/test_kernels_join.py \
+      tests/test_exprs.py tests/test_e2e_basic.py -q
+  exit 0
+fi
+
+echo "== full test suite"
+python -m pytest tests/ -q
+
+echo "== single-chip entry compile check"
+python - << 'PY'
+import jax
+import __graft_entry__ as g
+fn, args = g.entry()
+out = jax.jit(fn)(*args)
+print("entry ok:", [getattr(o, "shape", o) for o in out[:2]])
+PY
+
+echo "== 8-device multichip dryrun (virtual CPU mesh)"
+python - << 'PY'
+import __graft_entry__ as g
+g.dryrun_multichip(8)
+print("dryrun ok")
+PY
+
+echo "CI PASSED"

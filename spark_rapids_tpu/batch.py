@@ -75,6 +75,10 @@ class HostColumn:
         validity = np.array([x is not None for x in items], dtype=np.bool_)
         if dtype.is_string:
             values = np.array([x if x is not None else "" for x in items], dtype=object)
+        elif dtype.is_array:
+            values = np.empty(len(items), dtype=object)
+            for i, x in enumerate(items):
+                values[i] = list(x) if x is not None else []
         else:
             values = np.array(
                 [x if x is not None else 0 for x in items], dtype=dtype.np_dtype
@@ -83,11 +87,14 @@ class HostColumn:
 
     def to_list(self) -> List[Any]:
         out: List[Any] = []
+        elem = self.dtype.element if self.dtype.is_array else None
         for v, ok in zip(self.values, self.validity):
             if not ok:
                 out.append(None)
             elif self.dtype.is_string:
                 out.append(str(v))
+            elif self.dtype.is_array:
+                out.append([_pyval(elem, e) for e in v])
             elif self.dtype == T.BOOLEAN:
                 out.append(bool(v))
             elif self.dtype.is_fractional:
@@ -95,6 +102,16 @@ class HostColumn:
             else:
                 out.append(int(v))
         return out
+
+
+def _pyval(dtype: T.DataType, v):
+    if v is None:
+        return None  # element-level NULL (host representation only)
+    if dtype == T.BOOLEAN:
+        return bool(v)
+    if dtype.is_fractional:
+        return float(v)
+    return int(v)
 
 
 class HostBatch:
@@ -163,6 +180,11 @@ class DeviceColumn:
     @property
     def is_string(self) -> bool:
         return self.dtype.is_string
+
+    @property
+    def is_varlen(self) -> bool:
+        """Strings and arrays: flat element buffer + offsets."""
+        return self.offsets is not None
 
     def tree_flatten(self):
         if self.offsets is None:
@@ -258,6 +280,30 @@ def _string_host_to_buffers(values: np.ndarray, validity: np.ndarray,
     return offsets, data
 
 
+def _array_host_to_buffers(dtype: T.ArrayType, values: np.ndarray,
+                           validity: np.ndarray
+                           ) -> Tuple[np.ndarray, np.ndarray]:
+    """Encode an object array of lists to (offsets int32[n+1], flat elems)
+    — the same varlen layout strings use (strings ARE array<byte>)."""
+    lists = [list(v) if ok else [] for v, ok in zip(values, validity)]
+    if any(e is None for x in lists for e in x):
+        raise NotImplementedError(
+            "array element-level NULLs are host-only in the v1 nested "
+            "envelope; keep such columns on the CPU path (see "
+            "docs/compatibility.md)")
+    lengths = np.fromiter((len(x) for x in lists), dtype=np.int64,
+                          count=len(lists))
+    offsets = np.zeros(len(lists) + 1, dtype=np.int32)
+    np.cumsum(lengths, out=offsets[1:])
+    total = int(offsets[-1])
+    cap = round_up_capacity(max(total, 1), minimum=8)
+    data = np.zeros(cap, dtype=dtype.element.np_dtype)
+    if total:
+        flat = [e for x in lists for e in x]
+        data[:total] = np.asarray(flat, dtype=dtype.element.np_dtype)
+    return offsets, data
+
+
 def host_column_to_device(col: HostColumn, capacity: int,
                           device=None) -> DeviceColumn:
     n = len(col)
@@ -265,8 +311,12 @@ def host_column_to_device(col: HostColumn, capacity: int,
     validity = np.zeros(capacity, dtype=np.bool_)
     validity[:n] = col.validity
     put = (lambda x: jax.device_put(x, device)) if device is not None else jax.device_put
-    if col.dtype.is_string:
-        offsets, data = _string_host_to_buffers(col.values, col.validity)
+    if col.dtype.is_string or col.dtype.is_array:
+        if col.dtype.is_string:
+            offsets, data = _string_host_to_buffers(col.values, col.validity)
+        else:
+            offsets, data = _array_host_to_buffers(col.dtype, col.values,
+                                                   col.validity)
         full_offsets = np.full(capacity + 1, offsets[-1], dtype=np.int32)
         full_offsets[: n + 1] = offsets
         return DeviceColumn(col.dtype, put(data), put(validity), put(full_offsets))
@@ -292,7 +342,7 @@ def device_to_host_many(batches: Sequence[ColumnBatch]) -> List[HostBatch]:
     # device that dominated query wall time (see profile_bench.py).
     host = jax.device_get([
         (b.num_rows,
-         [(c.data, c.validity, c.offsets) if c.is_string
+         [(c.data, c.validity, c.offsets) if c.offsets is not None
           else (c.data, c.validity) for c in b.columns])
         for b in batches])
     out = []
@@ -309,6 +359,13 @@ def device_to_host_many(batches: Sequence[ColumnBatch]) -> List[HostBatch]:
                     values[i] = bytes(
                         data[offsets[i]:offsets[i + 1]]).decode(
                         "utf-8", errors="replace")
+                out_cols.append(HostColumn(f.dtype, values, validity))
+            elif f.dtype.is_array:
+                data = np.asarray(bufs[0])
+                offsets = np.asarray(bufs[2])
+                values = np.empty(n, dtype=object)
+                for i in range(n):
+                    values[i] = list(data[offsets[i]:offsets[i + 1]])
                 out_cols.append(HostColumn(f.dtype, values, validity))
             else:
                 data = np.asarray(bufs[0])[:n]
@@ -329,7 +386,7 @@ def host_sizes(batches: Sequence[ColumnBatch]) -> List[Tuple[int, List[int]]]:
     constant past num_rows by construction.
     """
     scalars = [(b.num_rows,
-                [c.offsets[-1] for c in b.columns if c.is_string])
+                [c.offsets[-1] for c in b.columns if c.is_varlen])
                for b in batches]
     host = jax.device_get(scalars)
     return [(int(n), [int(t) for t in totals]) for n, totals in host]

@@ -1,0 +1,180 @@
+"""TPC-DS-like star-schema benchmark: synthetic store_sales fact + item /
+date_dim / customer / store dimensions, and query definitions shaped like
+the TPC-DS reporting set (TpcdsLikeSpark analogue,
+integration_tests/.../TpcdsLikeSpark.scala — adapted to the engine's
+type/op envelope the same way TpchLike is).
+
+Query shapes covered: dimension-filtered fact scans with multi-way joins,
+group-by + order-by + limit reporting rollups (q3/q42/q52/q55 family),
+multi-aggregate demographic profiles (q7), and a two-level aggregation with
+a HAVING-style post-filter (q65 family).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+
+BRANDS = [f"brand#{i}" for i in range(1, 21)]
+CATEGORIES = ["Books", "Electronics", "Home", "Jewelry", "Men", "Music",
+              "Shoes", "Sports", "Toys", "Women"]
+STATES = ["CA", "GA", "IL", "NY", "TX", "WA"]
+EDU = ["Primary", "Secondary", "College", "2 yr Degree", "4 yr Degree"]
+
+# date_dim spans 1998-1999 weekly granularity style: d_date_sk is a dense key
+
+
+def gen_date_dim() -> Dict:
+    n = 730  # two years of days
+    sk = np.arange(1, n + 1)
+    year = np.where(sk <= 365, 1998, 1999)
+    doy = np.where(sk <= 365, sk, sk - 365)
+    moy = np.minimum((doy - 1) // 30 + 1, 12)
+    return {
+        "d_date_sk": (T.LONG, sk),
+        "d_year": (T.INT, year.astype(np.int32)),
+        "d_moy": (T.INT, moy.astype(np.int32)),
+        "d_dom": (T.INT, ((doy - 1) % 30 + 1).astype(np.int32)),
+    }
+
+
+def gen_item(sf: float, seed: int = 21) -> Dict:
+    n = max(10, int(sf * 2_000))
+    r = np.random.RandomState(seed)
+    return {
+        "i_item_sk": (T.LONG, np.arange(1, n + 1)),
+        "i_brand": (T.STRING, r.choice(BRANDS, n)),
+        "i_category": (T.STRING, r.choice(CATEGORIES, n)),
+        "i_manufact_id": (T.INT, r.randint(1, 100, n).astype(np.int32)),
+        "i_current_price": (T.DOUBLE, (r.rand(n) * 99 + 1).round(2)),
+    }
+
+
+def gen_customer(sf: float, seed: int = 22) -> Dict:
+    n = max(10, int(sf * 1_000))
+    r = np.random.RandomState(seed)
+    return {
+        "c_customer_sk": (T.LONG, np.arange(1, n + 1)),
+        "c_birth_year": (T.INT, r.randint(1924, 1992, n).astype(np.int32)),
+        "c_education": (T.STRING, r.choice(EDU, n)),
+        "c_state": (T.STRING, r.choice(STATES, n)),
+    }
+
+
+def gen_store(seed: int = 23) -> Dict:
+    n = 12
+    r = np.random.RandomState(seed)
+    return {
+        "s_store_sk": (T.LONG, np.arange(1, n + 1)),
+        "s_state": (T.STRING, r.choice(STATES, n)),
+    }
+
+
+def gen_store_sales(sf: float, seed: int = 24) -> Dict:
+    n = max(100, int(sf * 100_000))
+    r = np.random.RandomState(seed)
+    n_item = max(10, int(sf * 2_000))
+    n_cust = max(10, int(sf * 1_000))
+    price = (r.rand(n) * 200 + 1).round(2)
+    qty = r.randint(1, 101, n)
+    return {
+        "ss_sold_date_sk": (T.LONG, r.randint(1, 731, n)),
+        "ss_item_sk": (T.LONG, r.randint(1, n_item + 1, n)),
+        "ss_customer_sk": (T.LONG, r.randint(1, n_cust + 1, n)),
+        "ss_store_sk": (T.LONG, r.randint(1, 13, n)),
+        "ss_quantity": (T.INT, qty.astype(np.int32)),
+        "ss_sales_price": (T.DOUBLE, price),
+        "ss_ext_sales_price": (T.DOUBLE, (price * qty).round(2)),
+        "ss_ext_discount_amt": (T.DOUBLE, (r.rand(n) * 100).round(2)),
+        "ss_net_profit": (T.DOUBLE, ((r.rand(n) - 0.3) * 500).round(2)),
+    }
+
+
+def register_tpcds(session, sf: float = 0.1, num_partitions: int = 4):
+    tables = {
+        "store_sales": gen_store_sales(sf),
+        "item": gen_item(sf),
+        "customer": gen_customer(sf),
+        "date_dim": gen_date_dim(),
+        "store": gen_store(),
+    }
+    for name, data in tables.items():
+        df = session.create_dataframe(data, num_partitions=num_partitions)
+        session.register_view(name, df)
+
+
+# -- queries (TpcdsLikeSpark adaptation) ------------------------------------
+
+Q3 = """
+SELECT d_year, i_brand, sum(ss_ext_sales_price) AS sum_agg
+FROM store_sales
+JOIN date_dim ON d_date_sk = ss_sold_date_sk
+JOIN item ON i_item_sk = ss_item_sk
+WHERE i_manufact_id = 52 AND d_moy = 11
+GROUP BY d_year, i_brand
+ORDER BY d_year, sum_agg DESC, i_brand
+LIMIT 100
+"""
+
+Q7 = """
+SELECT i_category,
+       avg(ss_quantity) AS agg1,
+       avg(ss_sales_price) AS agg2,
+       avg(ss_ext_sales_price) AS agg3,
+       avg(ss_ext_discount_amt) AS agg4
+FROM store_sales
+JOIN customer ON c_customer_sk = ss_customer_sk
+JOIN item ON i_item_sk = ss_item_sk
+WHERE c_education = 'College' AND c_birth_year < 1970
+GROUP BY i_category
+ORDER BY i_category
+"""
+
+Q42 = """
+SELECT d_year, i_category, sum(ss_ext_sales_price) AS total
+FROM store_sales
+JOIN date_dim ON d_date_sk = ss_sold_date_sk
+JOIN item ON i_item_sk = ss_item_sk
+WHERE d_moy = 12 AND i_current_price > 50
+GROUP BY d_year, i_category
+ORDER BY total DESC, d_year, i_category
+LIMIT 100
+"""
+
+Q52 = """
+SELECT d_year, i_brand, sum(ss_ext_sales_price) AS ext_price
+FROM store_sales
+JOIN date_dim ON d_date_sk = ss_sold_date_sk
+JOIN item ON i_item_sk = ss_item_sk
+WHERE d_moy = 11 AND d_year = 1998
+GROUP BY d_year, i_brand
+ORDER BY d_year, ext_price DESC, i_brand
+LIMIT 100
+"""
+
+Q55 = """
+SELECT i_brand, sum(ss_ext_sales_price) AS ext_price
+FROM store_sales
+JOIN date_dim ON d_date_sk = ss_sold_date_sk
+JOIN item ON i_item_sk = ss_item_sk
+WHERE d_moy = 6 AND d_year = 1999
+GROUP BY i_brand
+ORDER BY ext_price DESC, i_brand
+LIMIT 100
+"""
+
+Q65 = """
+SELECT s_state, i_category, sum(ss_net_profit) AS profit
+FROM store_sales
+JOIN store ON s_store_sk = ss_store_sk
+JOIN item ON i_item_sk = ss_item_sk
+GROUP BY s_state, i_category
+HAVING sum(ss_net_profit) > 0
+ORDER BY s_state, profit DESC
+"""
+
+QUERIES = {"q3": Q3, "q7": Q7, "q42": Q42, "q52": Q52, "q55": Q55,
+           "q65": Q65}

@@ -257,6 +257,19 @@ MULTITHREADED_READ_THREADS = conf_int(
 PARQUET_ENABLED = conf_bool(
     "spark.rapids.sql.format.parquet.enabled", True,
     "Enable TPU-accelerated parquet scans.")
+SCAN_PUSHDOWN_ENABLED = conf_bool(
+    "spark.rapids.sql.scan.pushdown.enabled", True,
+    "Push filter conjuncts into file scans: parquet row groups are "
+    "skipped on min/max statistics and Hive key=value partition "
+    "directories are pruned before any decode.")
+AQE_COALESCE_ENABLED = conf_bool(
+    "spark.rapids.sql.adaptive.coalescePartitions.enabled", True,
+    "Group small post-shuffle partitions so each downstream task covers "
+    "a worthwhile row count (GpuCustomShuffleReaderExec role); join pairs "
+    "coalesce by combined size to stay co-partitioned.")
+AQE_TARGET_ROWS = conf_int(
+    "spark.rapids.sql.adaptive.targetPartitionRows", 1 << 16,
+    "Row-count target per coalesced post-shuffle partition.")
 CSV_ENABLED = conf_bool(
     "spark.rapids.sql.format.csv.enabled", True,
     "Enable TPU-accelerated CSV scans.")

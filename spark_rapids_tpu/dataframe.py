@@ -306,6 +306,23 @@ class DataFrame:
     def agg(self, *aggs) -> "DataFrame":
         return GroupedData(self, [], []).agg(*aggs)
 
+    def explode(self, column: str, alias: Optional[str] = None,
+                pos: bool = False, outer: bool = False) -> "DataFrame":
+        """Explode an array column: one output row per element, other
+        columns repeated (GpuGenerateExec analogue).  ``pos=True`` adds the
+        element position column; ``outer=True`` keeps empty/NULL arrays as
+        a NULL-element row (CPU path)."""
+        node = L.Generate(column, alias or "col", pos, outer, self.plan)
+        return DataFrame(node, self.session)
+
+    def map_in_pandas(self, fn, schema) -> "DataFrame":
+        """fn(Iterator[pd.DataFrame]) -> Iterator[pd.DataFrame] per
+        partition (GpuMapInPandasExec analogue)."""
+        return DataFrame(
+            L.MapInPandas(fn, _to_schema(schema), self.plan), self.session)
+
+    mapInPandas = map_in_pandas
+
     def join(self, other: "DataFrame", on=None, how: str = "inner"
              ) -> "DataFrame":
         how = {"leftouter": "left", "left_outer": "left",
@@ -664,6 +681,55 @@ class GroupedData:
 
     def max(self, *cols):
         return self._simple(Max, cols)
+
+    # -- pandas execs (GpuFlatMapGroupsInPandasExec family) -----------------
+
+    def apply_in_pandas(self, fn, schema) -> DataFrame:
+        """fn(pd.DataFrame) -> pd.DataFrame per group
+        (GpuFlatMapGroupsInPandasExec analogue)."""
+        schema = _to_schema(schema)
+        node = L.FlatMapGroupsInPandas(self.keys, self.names, fn, schema,
+                                       self.df.plan)
+        return DataFrame(node, self.df.session)
+
+    applyInPandas = apply_in_pandas
+
+    def agg_in_pandas(self, specs) -> DataFrame:
+        """specs: {out_name: (fn, dtype, col)} with fn(pd.Series) -> scalar
+        (GpuAggregateInPandasExec / GROUPED_AGG pandas_udf analogue)."""
+        agg_specs = [(name, fn, dt, col)
+                     for name, (fn, dt, col) in specs.items()]
+        node = L.AggregateInPandas(self.keys, self.names, agg_specs,
+                                   self.df.plan)
+        return DataFrame(node, self.df.session)
+
+    def cogroup(self, other: "GroupedData") -> "CoGroupedData":
+        return CoGroupedData(self, other)
+
+
+class CoGroupedData:
+    """a.group_by(k).cogroup(b.group_by(k)).apply_in_pandas(fn, schema)
+    (GpuFlatMapCoGroupsInPandasExec analogue)."""
+
+    def __init__(self, left: GroupedData, right: GroupedData):
+        self.left = left
+        self.right = right
+
+    def apply_in_pandas(self, fn, schema) -> DataFrame:
+        schema = _to_schema(schema)
+        node = L.FlatMapCoGroupsInPandas(
+            self.left.keys, self.left.names, self.right.keys,
+            self.right.names, fn, schema, self.left.df.plan,
+            self.right.df.plan)
+        return DataFrame(node, self.left.df.session)
+
+    applyInPandas = apply_in_pandas
+
+
+def _to_schema(schema) -> T.Schema:
+    if isinstance(schema, T.Schema):
+        return schema
+    return T.Schema(schema)
 
 
 def _resolve_agg(fn: AggregateFunction, schema: T.Schema

@@ -79,8 +79,9 @@ class AggregateFunction(Expression):
 
 
 def _seg_any_valid(valid, seg_ids, num_segments, live_mask):
-    return jax.ops.segment_max((valid & live_mask).astype(jnp.int32), seg_ids,
-                               num_segments=num_segments) > 0
+    # scatter-ADD (not max): adds combine in-lane on TPU scatters
+    return jax.ops.segment_sum((valid & live_mask).astype(jnp.int32), seg_ids,
+                               num_segments=num_segments, indices_are_sorted=True) > 0
 
 
 class Sum(AggregateFunction):
@@ -95,7 +96,7 @@ class Sum(AggregateFunction):
         x = v.data.astype(self.dtype.jnp_dtype)
         use = v.validity & live_mask
         s = jax.ops.segment_sum(jnp.where(use, x, 0), seg_ids,
-                                num_segments=num_segments)
+                                num_segments=num_segments, indices_are_sorted=True)
         any_v = _seg_any_valid(v.validity, seg_ids, num_segments, live_mask)
         ones = jnp.ones(num_segments, dtype=jnp.bool_)
         return [DevVal(self.dtype, s, ones), DevVal(T.BOOLEAN, any_v, ones)]
@@ -103,7 +104,7 @@ class Sum(AggregateFunction):
     def segment_merge(self, buffers, seg_ids, num_segments, live_mask):
         s, has = buffers
         total = jax.ops.segment_sum(
-            jnp.where(live_mask, s.data, 0), seg_ids, num_segments=num_segments)
+            jnp.where(live_mask, s.data, 0), seg_ids, num_segments=num_segments, indices_are_sorted=True)
         any_v = _seg_any_valid(has.data.astype(jnp.bool_), seg_ids,
                                num_segments, live_mask)
         ones = jnp.ones(num_segments, dtype=jnp.bool_)
@@ -136,13 +137,13 @@ class Count(AggregateFunction):
     def segment_update(self, v, seg_ids, num_segments, live_mask):
         use = v.validity & live_mask
         c = jax.ops.segment_sum(use.astype(jnp.int64), seg_ids,
-                                num_segments=num_segments)
+                                num_segments=num_segments, indices_are_sorted=True)
         return [DevVal(T.LONG, c, jnp.ones(num_segments, dtype=jnp.bool_))]
 
     def segment_merge(self, buffers, seg_ids, num_segments, live_mask):
         c = jax.ops.segment_sum(
             jnp.where(live_mask, buffers[0].data, 0), seg_ids,
-            num_segments=num_segments)
+            num_segments=num_segments, indices_are_sorted=True)
         return [DevVal(T.LONG, c, jnp.ones(num_segments, dtype=jnp.bool_))]
 
     def finalize(self, buffers):
@@ -179,8 +180,8 @@ class _MinMax(AggregateFunction):
 
     def _seg_reduce(self, x, seg_ids, num_segments):
         if self._is_min:
-            return jax.ops.segment_min(x, seg_ids, num_segments=num_segments)
-        return jax.ops.segment_max(x, seg_ids, num_segments=num_segments)
+            return jax.ops.segment_min(x, seg_ids, num_segments=num_segments, indices_are_sorted=True)
+        return jax.ops.segment_max(x, seg_ids, num_segments=num_segments, indices_are_sorted=True)
 
     def segment_update(self, v, seg_ids, num_segments, live_mask):
         use = v.validity & live_mask
@@ -234,18 +235,18 @@ class Average(AggregateFunction):
         use = v.validity & live_mask
         x = v.data.astype(jnp.float64)
         s = jax.ops.segment_sum(jnp.where(use, x, 0.0), seg_ids,
-                                num_segments=num_segments)
+                                num_segments=num_segments, indices_are_sorted=True)
         c = jax.ops.segment_sum(use.astype(jnp.int64), seg_ids,
-                                num_segments=num_segments)
+                                num_segments=num_segments, indices_are_sorted=True)
         ones = jnp.ones(num_segments, dtype=jnp.bool_)
         return [DevVal(T.DOUBLE, s, ones), DevVal(T.LONG, c, ones)]
 
     def segment_merge(self, buffers, seg_ids, num_segments, live_mask):
         s, c = buffers
         st = jax.ops.segment_sum(jnp.where(live_mask, s.data, 0.0), seg_ids,
-                                 num_segments=num_segments)
+                                 num_segments=num_segments, indices_are_sorted=True)
         ct = jax.ops.segment_sum(jnp.where(live_mask, c.data, 0), seg_ids,
-                                 num_segments=num_segments)
+                                 num_segments=num_segments, indices_are_sorted=True)
         ones = jnp.ones(num_segments, dtype=jnp.bool_)
         return [DevVal(T.DOUBLE, st, ones), DevVal(T.LONG, ct, ones)]
 
@@ -288,9 +289,9 @@ class _FirstLast(AggregateFunction):
         big = jnp.int64(jnp.iinfo(jnp.int64).max // 2)
         key = jnp.where(candidate, idx, big if self._is_first else -big)
         if self._is_first:
-            best = jax.ops.segment_min(key, seg_ids, num_segments=num_segments)
+            best = jax.ops.segment_min(key, seg_ids, num_segments=num_segments, indices_are_sorted=True)
         else:
-            best = jax.ops.segment_max(key, seg_ids, num_segments=num_segments)
+            best = jax.ops.segment_max(key, seg_ids, num_segments=num_segments, indices_are_sorted=True)
         # Scatter values of winners into group slots.
         winner = candidate & (best[seg_ids] == key)
         out_val = jnp.zeros(num_segments, dtype=v_data.dtype)
@@ -300,7 +301,7 @@ class _FirstLast(AggregateFunction):
         out_ok = out_ok.at[jnp.where(winner, seg_ids, num_segments)].set(
             v_valid, mode="drop")
         has = jax.ops.segment_max(candidate.astype(jnp.int32), seg_ids,
-                                  num_segments=num_segments) > 0
+                                  num_segments=num_segments, indices_are_sorted=True) > 0
         best_idx = jnp.where(has, best, 0)
         return out_val, out_ok & has, best_idx
 

@@ -160,6 +160,10 @@ class Expression:
 
     def tpu_supported(self, conf) -> Optional[str]:
         """Return None if supported on TPU, else a willNotWorkOnTpu reason."""
+        if isinstance(self.dtype, T.ArrayType):
+            # fixed-width-element arrays ride the varlen (offsets) layout;
+            # consumers beyond project/filter/explode are gated at plan level
+            return None
         if self.dtype not in T.ALL_TYPES and not isinstance(self.dtype, T.NullType):
             return f"unsupported result type {self.dtype}"
         return None

@@ -96,3 +96,55 @@ class KnownFloatingPointNormalized(UnaryExpression):
         data = np.where(v.values == 0, np.zeros_like(v.values), v.values)
         data = np.where(np.isnan(data), np.full_like(data, np.nan), data)
         return CpuVal(v.dtype, data, v.validity)
+
+
+class CreateArray(Expression):
+    """array(e1, e2, ...) -> array<common element type>
+    (GpuCreateArray, complexTypeCreator analogue).  TPU path requires
+    non-nullable inputs (element-level NULLs are host-only in the v1
+    nested envelope); nullable inputs fall back to CPU."""
+
+    def __init__(self, *children: Expression):
+        assert children, "array() needs at least one element"
+        elem = children[0].dtype
+        for c in children[1:]:
+            elem = T.promote(elem, c.dtype)
+        self.children = tuple(children)
+        self.dtype = T.ArrayType(elem)
+        self.nullable = False
+
+    def with_children(self, children):
+        return CreateArray(*children)
+
+    def tpu_supported(self, conf):
+        if any(c.nullable for c in self.children):
+            return ("array() with nullable inputs can produce NULL "
+                    "elements (host-only in the v1 nested envelope)")
+        return None
+
+    def tpu_eval(self, ctx) -> DevVal:
+        import jax.numpy as jnp
+        elem = self.dtype.element
+        vals = [c.tpu_eval(ctx) for c in self.children]
+        k = len(vals)
+        cap = ctx.capacity
+        data = jnp.stack([v.data.astype(elem.jnp_dtype) for v in vals],
+                         axis=1).reshape(-1)  # row-major [cap*k]
+        offsets = (jnp.arange(cap + 1, dtype=jnp.int32) * k)
+        # live rows only: clamp offsets past num_rows to the live total
+        total = ctx.num_rows * k
+        offsets = jnp.minimum(offsets, total.astype(jnp.int32))
+        return DevVal(self.dtype, data,
+                      jnp.ones(cap, dtype=jnp.bool_), offsets)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        vals = [c.cpu_eval(ctx) for c in self.children]
+        n = ctx.num_rows
+        out = np.empty(n, dtype=object)
+        elem = self.dtype.element
+        for i in range(n):
+            out[i] = [
+                (None if not v.validity[i] else
+                 T.np_scalar(elem, v.values[i]))
+                for v in vals]
+        return CpuVal(self.dtype, out, np.ones(n, dtype=np.bool_))

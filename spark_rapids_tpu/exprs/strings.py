@@ -77,7 +77,7 @@ def string_hash2(v: DevVal) -> Tuple[jnp.ndarray, jnp.ndarray]:
         pows = _pow_table(base, nbytes)
         contrib = byte * pows[exp]
         h = jax.ops.segment_sum(jnp.where(in_data, contrib, 0), rows_c,
-                                num_segments=cap)
+                                num_segments=cap, indices_are_sorted=True)
         # Mix in length so "" vs padding rows differ and lengths disambiguate.
         h = h + string_lengths(v).astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
         out.append(h.astype(jnp.uint32))
@@ -155,7 +155,7 @@ def _rows_with_match(v: DevVal, needle: bytes):
     match = _find_matches(v, needle)
     nbytes = int(v.data.shape[0])
     rows = jnp.clip(rows_of_positions(v.offsets, nbytes), 0, cap - 1)
-    counts = jax.ops.segment_sum(match.astype(jnp.int32), rows, num_segments=cap)
+    counts = jax.ops.segment_sum(match.astype(jnp.int32), rows, num_segments=cap, indices_are_sorted=True)
     has = counts > 0
     if len(needle) == 0:
         has = jnp.ones(cap, dtype=jnp.bool_)
@@ -308,14 +308,14 @@ class _Trim(UnaryExpression):
         if self._left:
             first_ns = jax.ops.segment_min(
                 jnp.where(~is_space & in_data, pos_in_row, big), rows,
-                num_segments=cap)
+                num_segments=cap, indices_are_sorted=True)
             lead = jnp.where(first_ns > lens, lens, first_ns.astype(jnp.int32))
         else:
             lead = jnp.zeros(cap, dtype=jnp.int32)
         if self._right:
             last_ns = jax.ops.segment_max(
                 jnp.where(~is_space & in_data, pos_in_row, -1), rows,
-                num_segments=cap)
+                num_segments=cap, indices_are_sorted=True)
             trail = lens - 1 - last_ns.astype(jnp.int32)
             trail = jnp.clip(trail, 0, lens)
         else:
@@ -596,7 +596,7 @@ class StringLocate(Expression):
         pos_in_row = jnp.arange(nbytes, dtype=jnp.int32) - v.offsets[rows]
         big = jnp.int32(nbytes + 1)
         first = jax.ops.segment_min(jnp.where(match, pos_in_row, big), rows,
-                                    num_segments=cap)
+                                    num_segments=cap, indices_are_sorted=True)
         data = jnp.where(first >= big, 0, first + 1).astype(jnp.int32)
         return DevVal(T.INT, data, v.validity)
 
@@ -628,7 +628,7 @@ def _replace_match_starts(v: DevVal, match, Ls: int, repl: bytes,
     Lr = len(repl)
     rows = jnp.clip(rows_of_positions(v.offsets, nbytes), 0, cap - 1)
     n_matches = jax.ops.segment_sum(match.astype(jnp.int32), rows,
-                                    num_segments=cap)
+                                    num_segments=cap, indices_are_sorted=True)
     lens = string_lengths(v)
     new_lens = lens + n_matches * (Lr - Ls)
     new_lens = jnp.where(v.validity & ctx.row_mask, new_lens, 0)
@@ -922,8 +922,11 @@ class RegExpReplace(Expression):
     def cpu_eval(self, ctx) -> CpuVal:
         import re
         v = self.children[0].cpu_eval(ctx)
-        pat = str(_literal_needle(self.children[1]) or "")
-        repl = str(_literal_needle(self.children[2]) or "")
+        pat = _literal_needle(self.children[1])
+        repl = _literal_needle(self.children[2])
+        if pat is None or repl is None:
+            raise NotImplementedError(
+                "regexp_replace pattern/replacement must be literals")
         rx = re.compile(pat)
         out = np.array([rx.sub(repl, str(s)) for s in v.values],
                        dtype=object)
@@ -975,9 +978,9 @@ class SplitPart(Expression):
         def match_pos(k):
             sel = match & (rank == k)
             return jax.ops.segment_min(
-                jnp.where(sel, pos, big), rows, num_segments=cap)
+                jnp.where(sel, pos, big), rows, num_segments=cap, indices_are_sorted=True)
 
-        n_matches = jax.ops.segment_sum(starts_i, rows, num_segments=cap)
+        n_matches = jax.ops.segment_sum(starts_i, rows, num_segments=cap, indices_are_sorted=True)
         row_start = v.offsets[:-1]
         row_end = v.offsets[1:]
         start = row_start if j == 0 else \
@@ -992,7 +995,10 @@ class SplitPart(Expression):
 
     def cpu_eval(self, ctx) -> CpuVal:
         v = self.children[0].cpu_eval(ctx)
-        d = str(_literal_needle(self.children[1]) or "")
+        d = _literal_needle(self.children[1])
+        if d is None:
+            raise NotImplementedError(
+                "split_part delimiter must be a literal")
         out = np.empty(len(v.values), dtype=object)
         for i, s in enumerate(v.values):
             parts = str(s).split(d) if d else [str(s)]
@@ -1023,26 +1029,21 @@ class ConcatWs(Expression):
         return None
 
     def tpu_eval(self, ctx) -> DevVal:
+        cap = ctx.capacity
+        if not self.children:
+            # Spark: concat_ws(sep) with no columns is '' per row
+            return DevVal(T.STRING, jnp.zeros(16, dtype=jnp.uint8),
+                          jnp.ones(cap, dtype=jnp.bool_),
+                          jnp.zeros(cap + 1, dtype=jnp.int32))
         sep = self.sep.encode("utf-8")
         Lsep = len(sep)
         sep_arr = jnp.asarray(np.frombuffer(sep, dtype=np.uint8)) \
             if Lsep else jnp.zeros(1, dtype=jnp.uint8)
         vals = [c.tpu_eval(ctx) for c in self.children]
-        cap = ctx.capacity
-        acc = vals[0]
-        # normalize: null -> empty, track has_any
-        l0 = jnp.where(acc.validity, string_lengths(acc), 0)
-        acc = DevVal(T.STRING,
-                     acc.data,
-                     jnp.ones(cap, dtype=jnp.bool_),
-                     jnp.concatenate([jnp.zeros(1, jnp.int32),
-                                      jnp.cumsum(jnp.where(
-                                          ctx.row_mask, l0, 0)).astype(
-                                              jnp.int32)]))
-        # rebuild acc bytes for the masked lens (drop bytes of null rows)
+        # normalize the accumulator: NULL rows contribute zero bytes
+        l0 = jnp.where(vals[0].validity, string_lengths(vals[0]), 0)
         acc = _gather_substring(
-            DevVal(T.STRING, vals[0].data, vals[0].validity,
-                   vals[0].offsets),
+            vals[0],
             jnp.zeros(cap, dtype=jnp.int32),
             jnp.where(vals[0].validity & ctx.row_mask, l0, 0),
             int(vals[0].data.shape[0]),

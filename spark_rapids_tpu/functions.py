@@ -286,6 +286,12 @@ def hash(*cols) -> Column:  # noqa: A001
         col(c) if isinstance(c, str) else c) for c in cols]))
 
 
+def array(*cols) -> Column:
+    from spark_rapids_tpu.exprs.misc import CreateArray
+    return Column(CreateArray(*[_to_expr(
+        col(c) if isinstance(c, str) else c) for c in cols]))
+
+
 def monotonically_increasing_id() -> Column:
     from spark_rapids_tpu.exprs.misc import MonotonicallyIncreasingID
     return Column(MonotonicallyIncreasingID())

@@ -33,6 +33,75 @@ def expand_paths(paths: List[str], fmt: str) -> List[str]:
     return files
 
 
+HIVE_DEFAULT_PARTITION = "__HIVE_DEFAULT_PARTITION__"
+
+
+def discover_partitions(paths: List[str], files: List[str]):
+    """Hive-layout partition discovery: ``key=value`` directory components
+    under the scan roots become partition columns
+    (ColumnarPartitionReaderWithPartitionValues.scala /
+    PartitioningAwareFileIndex role).
+
+    Returns (partition_schema, {file: [typed values...]}) or None when the
+    layout is not a consistent key=value tree.
+    """
+    roots = [os.path.abspath(p) for p in paths if os.path.isdir(p)]
+    if not roots:
+        return None
+    keys_order: List[str] = None
+    raw: Dict[str, List[str]] = {}
+    for f in files:
+        af = os.path.abspath(f)
+        comps = None
+        for r in roots:
+            if af.startswith(r + os.sep):
+                rel = os.path.relpath(os.path.dirname(af), r)
+                comps = [] if rel == "." else rel.split(os.sep)
+                break
+        if comps is None:
+            return None
+        kv = []
+        for c in comps:
+            if "=" not in c:
+                return None
+            k, _, v = c.partition("=")
+            kv.append((k, v))
+        ks = [k for k, _ in kv]
+        if keys_order is None:
+            keys_order = ks
+        elif keys_order != ks:
+            return None
+        raw[f] = [v for _, v in kv]
+    if not keys_order:
+        return None
+
+    # per-key type inference (Spark: numeric partition values -> numbers)
+    def typed(values: List[str]):
+        non_null = [v for v in values if v != HIVE_DEFAULT_PARTITION]
+        try:
+            [int(v) for v in non_null]
+            return T.LONG, (lambda v: None if v == HIVE_DEFAULT_PARTITION
+                            else int(v))
+        except ValueError:
+            pass
+        try:
+            [float(v) for v in non_null]
+            return T.DOUBLE, (lambda v: None if v == HIVE_DEFAULT_PARTITION
+                              else float(v))
+        except ValueError:
+            return T.STRING, (lambda v: None if v == HIVE_DEFAULT_PARTITION
+                              else v)
+
+    fields, convs = [], []
+    for i, k in enumerate(keys_order):
+        dt, conv = typed([raw[f][i] for f in files])
+        fields.append(T.Field(k, dt))
+        convs.append(conv)
+    file_values = {
+        f: [conv(v) for conv, v in zip(convs, raw[f])] for f in files}
+    return T.Schema(fields), file_values
+
+
 def infer_schema(fmt: str, files: List[str],
                  options: Dict[str, Any]) -> T.Schema:
     from spark_rapids_tpu.io.arrow_convert import schema_from_arrow

@@ -2,17 +2,24 @@
 HostBatches (GpuParquetScan.scala:68 structure: host-side footer/filter work,
 then decode; here decode itself is host-side by design — SURVEY.md 2.9 row 2 —
 with a read-ahead thread pool mirroring MultiFileParquetPartitionReader,
-GpuParquetScan.scala:647-700)."""
+GpuParquetScan.scala:647-700).
+
+Predicate pushdown: planner-pushed conjuncts become (column, op, literal)
+descriptors; parquet row groups whose min/max statistics prove no row can
+match are skipped before any decode (GpuParquetScan.scala:217-281
+clipBlocksToSchema + filterBlocks role), and partition-column predicates
+prune whole files (PartitioningAwareFileIndex pruning role).
+"""
 
 from __future__ import annotations
 
 import concurrent.futures
-import queue
-import threading
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
 
 from spark_rapids_tpu import types as T
-from spark_rapids_tpu.batch import HostBatch
+from spark_rapids_tpu.batch import HostBatch, HostColumn
 from spark_rapids_tpu.config import (
     MULTITHREADED_READ_THREADS, RapidsConf,
 )
@@ -21,12 +28,103 @@ from spark_rapids_tpu.io.discovery import csv_options
 from spark_rapids_tpu.plan.physical import CpuExec, ExecContext
 
 
-def _read_parquet_file(path: str, columns: List[str], batch_rows: int,
-                       filters=None) -> List[HostBatch]:
-    import pyarrow.parquet as pq
+# -- pushed-filter descriptors ----------------------------------------------
+
+
+def extract_pushdown_descriptors(exprs) -> List[Tuple[str, str, Any]]:
+    """(column, op, literal) descriptors from pushed filter conjuncts; ops:
+    eq/lt/le/gt/ge/notnull.  Anything unconvertible is simply dropped —
+    pushdown is advisory, the full Filter still runs above the scan."""
+    from spark_rapids_tpu.exprs.base import ColumnRef, Literal
+    from spark_rapids_tpu.exprs.nullexprs import IsNotNull
+    from spark_rapids_tpu.exprs.predicates import (
+        Equals, GreaterThan, GreaterThanOrEqual, LessThan, LessThanOrEqual,
+    )
+    flip = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq"}
+    ops = {Equals: "eq", LessThan: "lt", LessThanOrEqual: "le",
+           GreaterThan: "gt", GreaterThanOrEqual: "ge"}
     out = []
+    for e in exprs:
+        if isinstance(e, IsNotNull) and isinstance(e.child, ColumnRef):
+            out.append((e.child.column, "notnull", None))
+            continue
+        op = ops.get(type(e))
+        if op is None or len(e.children) != 2:
+            continue
+        lhs, rhs = e.children
+        if isinstance(lhs, ColumnRef) and isinstance(rhs, Literal) and \
+                rhs.value is not None:
+            out.append((lhs.column, op, rhs.value))
+        elif isinstance(rhs, ColumnRef) and isinstance(lhs, Literal) and \
+                lhs.value is not None:
+            out.append((rhs.column, flip[op], lhs.value))
+    return out
+
+
+def _range_can_match(op: str, value, vmin, vmax) -> bool:
+    """Can any v in [vmin, vmax] satisfy `v <op> value`?"""
+    try:
+        if op == "eq":
+            return not (value < vmin or value > vmax)
+        if op == "lt":
+            return vmin < value
+        if op == "le":
+            return vmin <= value
+        if op == "gt":
+            return vmax > value
+        if op == "ge":
+            return vmax >= value
+    except TypeError:
+        return True  # incomparable types: keep
+    return True
+
+
+def _row_group_can_match(meta_rg, col_index: Dict[str, int],
+                         descriptors) -> bool:
+    for name, op, value in descriptors:
+        ci = col_index.get(name)
+        if ci is None:
+            continue
+        col = meta_rg.column(ci)
+        stats = col.statistics
+        if stats is None:
+            continue
+        if op == "notnull":
+            if stats.null_count is not None and \
+                    stats.null_count == meta_rg.num_rows:
+                return False
+            continue
+        if not stats.has_min_max:
+            continue
+        if not _range_can_match(op, value, stats.min, stats.max):
+            return False
+    return True
+
+
+def _read_parquet_file(path: str, columns: List[str], batch_rows: int,
+                       descriptors=None,
+                       counters: Optional[Dict[str, int]] = None
+                       ) -> List[HostBatch]:
+    import pyarrow.parquet as pq
     f = pq.ParquetFile(path)
-    for rb in f.iter_batches(batch_size=batch_rows,
+    meta = f.metadata
+    n_rg = meta.num_row_groups
+    keep: List[int] = []
+    col_index = {meta.schema.column(i).name: i
+                 for i in range(meta.num_columns)}
+    for i in range(n_rg):
+        if not descriptors or _row_group_can_match(
+                meta.row_group(i), col_index, descriptors):
+            keep.append(i)
+    if counters is not None:
+        counters["row_groups_total"] = counters.get("row_groups_total", 0) \
+            + n_rg
+        counters["row_groups_read"] = counters.get("row_groups_read", 0) \
+            + len(keep)
+    out = []
+    if not keep:
+        return out
+    for rb in f.iter_batches(batch_size=batch_rows, row_groups=keep,
                              columns=columns or None):
         out.append(arrow_to_host_batch(rb))
     return out
@@ -72,23 +170,90 @@ class CpuFileScanExec(CpuExec):
         self.paths = node.paths
         self.options = node.options
         self._nthreads = MULTITHREADED_READ_THREADS.get(conf)
+        self.partitions_info = getattr(node, "partitions", None)
+        self.descriptors = extract_pushdown_descriptors(node.pushed_filters)
+        if self.partitions_info is not None:
+            # partition pruning: drop whole files whose partition values
+            # cannot satisfy the pushed predicates
+            part_schema, file_values = self.partitions_info
+            names = part_schema.names
+            kept = []
+            for p in self.paths:
+                vals = dict(zip(names, file_values[p]))
+                if self._file_can_match(vals):
+                    kept.append(p)
+            self.paths = kept
+
+    def _file_can_match(self, part_vals: Dict[str, Any]) -> bool:
+        for name, op, value in self.descriptors:
+            if name not in part_vals:
+                continue
+            v = part_vals[name]
+            if v is None:
+                return False  # NULL partition value fails any comparison
+            if op == "notnull":
+                continue
+            if not _range_can_match(op, value, v, v):
+                return False
+        return True
 
     def describe(self):
-        return f"CpuFileScan({self.fmt}, {len(self.paths)} files)"
+        extra = f", pushed={len(self.descriptors)}" if self.descriptors \
+            else ""
+        return f"CpuFileScan({self.fmt}, {len(self.paths)} files{extra})"
 
     def num_partitions(self, ctx):
-        return max(1, min(len(self.paths), self.conf.shuffle_partitions))
+        return max(1, min(max(len(self.paths), 1),
+                          self.conf.shuffle_partitions))
 
-    def _read_file(self, path: str) -> List[HostBatch]:
+    def _read_file(self, path: str,
+                   counters: Optional[Dict[str, int]] = None
+                   ) -> List[HostBatch]:
         batch_rows = self.conf.max_readers_batch_size_rows
-        columns = self.output_schema.names
+        part_fields = []
+        if self.partitions_info is not None:
+            part_fields = self.partitions_info[0].fields
+        part_names = {f.name for f in part_fields}
+        columns = [n for n in self.output_schema.names
+                   if n not in part_names]
         if self.fmt == "parquet":
-            return _read_parquet_file(path, columns, batch_rows)
-        if self.fmt == "orc":
-            return _read_orc_file(path, columns, batch_rows)
-        if self.fmt == "csv":
-            return _read_csv_file(path, columns, batch_rows, self.options)
-        raise ValueError(self.fmt)
+            batches = _read_parquet_file(path, columns, batch_rows,
+                                         self.descriptors, counters)
+        elif self.fmt == "orc":
+            batches = _read_orc_file(path, columns, batch_rows)
+        elif self.fmt == "csv":
+            batches = _read_csv_file(path, columns, batch_rows, self.options)
+        else:
+            raise ValueError(self.fmt)
+        if self.partitions_info is None or not batches:
+            return batches
+        # append this file's constant partition-value columns
+        # (ColumnarPartitionReaderWithPartitionValues role)
+        _part_schema, file_values = self.partitions_info
+        vals = dict(zip(_part_schema.names, file_values[path]))
+        out = []
+        for hb in batches:
+            cols = {f.name: c for f, c in zip(hb.schema.fields, hb.columns)}
+            ordered = []
+            for f in self.output_schema.fields:
+                if f.name in cols:
+                    ordered.append(cols[f.name])
+                else:
+                    v = vals[f.name]
+                    n = hb.num_rows
+                    if v is None:
+                        values = np.zeros(n, dtype=object
+                                          if f.dtype.is_string
+                                          else f.dtype.np_dtype)
+                        validity = np.zeros(n, dtype=np.bool_)
+                    else:
+                        values = np.full(
+                            n, v, dtype=object if f.dtype.is_string
+                            else f.dtype.np_dtype)
+                        validity = np.ones(n, dtype=np.bool_)
+                    ordered.append(HostColumn(f.dtype, values, validity))
+            out.append(HostBatch(self.output_schema, ordered))
+        return out
 
     def partitions(self, ctx: ExecContext):
         n = self.num_partitions(ctx)
@@ -97,13 +262,22 @@ class CpuFileScanExec(CpuExec):
             groups[i % n].append(p)
         pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=self._nthreads)
+        rg_read = ctx.metric(self.op_id, "rowGroupsRead")
+        rg_total = ctx.metric(self.op_id, "rowGroupsTotal")
 
         def gen(files: List[str]):
             # read-ahead: submit all files in this partition to the pool
-            futures = [pool.submit(self._read_file, f) for f in files]
+            # (one counter dict per file: no cross-thread read-modify-write)
+            counter_list = [dict() for _ in files]
+            futures = [pool.submit(self._read_file, f, c)
+                       for f, c in zip(files, counter_list)]
             for fu in futures:
                 for hb in fu.result():
                     if hb.num_rows:
                         yield hb
+            rg_read.add(sum(c.get("row_groups_read", 0)
+                            for c in counter_list))
+            rg_total.add(sum(c.get("row_groups_total", 0)
+                             for c in counter_list))
 
         return [gen(g) for g in groups]

@@ -184,7 +184,8 @@ def join_pairs(left_keys: List[DevVal], left_num_rows,
         r_idx = build_row[order]
         # per-left-row match counts + right matched flags (for outer joins)
         ones = match.astype(jnp.int32)
-        l_counts = jax.ops.segment_sum(ones, probe_row, num_segments=l_cap)
+        l_counts = jax.ops.segment_sum(ones, probe_row, num_segments=l_cap,
+                                       indices_are_sorted=True)
         r_matched = jax.ops.segment_max(
             ones, build_row, num_segments=r_cap) > 0
         return l_idx.astype(jnp.int32), r_idx.astype(jnp.int32), n_pairs, \
@@ -206,14 +207,71 @@ def _string_byte_caps(batch: ColumnBatch, indices, live) -> List[int]:
     return caps
 
 
+def _filter_pairs(left: ColumnBatch, right: ColumnBatch, l_idx, r_idx,
+                  n_pairs, condition):
+    """Apply a residual join condition to the matched pairs BEFORE any
+    null-padding (GpuHashJoin.scala:265-271: the condition gates matches,
+    so a row whose every match fails becomes an *unmatched* outer row).
+
+    Only the columns the condition references are gathered.  Returns the
+    filtered (l_idx, r_idx, n_pairs, l_counts, r_matched).
+    """
+    from spark_rapids_tpu.exprs.base import TpuEvalCtx
+    pair_cap = int(l_idx.shape[0])
+    l_cap, r_cap = left.capacity, right.capacity
+    refs = set(condition.references)
+    live = jnp.arange(pair_cap, dtype=jnp.int32) < n_pairs
+
+    fields, cols = [], []
+    for side, idx in ((left, l_idx), (right, r_idx)):
+        for f, c in zip(side.schema.fields, side.columns):
+            if f.name not in refs:
+                continue
+            sub = ColumnBatch(T.Schema([f]), [c], side.num_rows,
+                              side.capacity)
+            bcaps = _string_byte_caps(sub, idx, live)
+            g = gather_rows(sub, idx, n_pairs, out_capacity=pair_cap,
+                            out_byte_caps=bcaps or None)
+            fields.append(f)
+            cols.append(g.columns[0])
+    paired = ColumnBatch(T.Schema(fields), cols, n_pairs, pair_cap)
+    v = condition.tpu_eval(TpuEvalCtx(paired))
+    keep = live & v.validity & v.data.astype(jnp.bool_)
+
+    order = jnp.argsort(jnp.where(keep, 0, 1), stable=True).astype(jnp.int32)
+    new_n = jnp.sum(keep).astype(jnp.int32)
+    new_l = l_idx[order]
+    new_r = r_idx[order]
+    ones = keep.astype(jnp.int32)
+    l_counts = jax.ops.segment_sum(
+        ones, jnp.clip(l_idx, 0, l_cap - 1), num_segments=l_cap)
+    r_matched = jax.ops.segment_max(
+        ones, jnp.clip(r_idx, 0, r_cap - 1), num_segments=r_cap) > 0
+    return new_l, new_r, new_n, l_counts, r_matched
+
+
 def hash_join(left: ColumnBatch, left_keys: List[DevVal],
               right: ColumnBatch, right_keys: List[DevVal],
-              join_type: str, out_schema: T.Schema) -> ColumnBatch:
+              join_type: str, out_schema: T.Schema,
+              condition=None) -> ColumnBatch:
     """Full equi-join of two batches.  Output columns = left cols ++ right
-    cols (semi/anti: left only), per ``out_schema``."""
-    l_cap, r_cap = left.capacity, right.capacity
+    cols (semi/anti: left only), per ``out_schema``.  ``condition`` is an
+    optional residual expression applied to matched pairs (before outer
+    null-padding, so it changes which rows count as matched)."""
     l_idx, r_idx, n_pairs, l_counts, r_matched = join_pairs(
         left_keys, left.num_rows, right_keys, right.num_rows)
+    if condition is not None:
+        l_idx, r_idx, n_pairs, l_counts, r_matched = _filter_pairs(
+            left, right, l_idx, r_idx, n_pairs, condition)
+    return stitch_join_output(left, right, l_idx, r_idx, n_pairs, l_counts,
+                              r_matched, join_type, out_schema)
+
+
+def stitch_join_output(left: ColumnBatch, right: ColumnBatch, l_idx, r_idx,
+                       n_pairs, l_counts, r_matched, join_type: str,
+                       out_schema: T.Schema) -> ColumnBatch:
+    """Materialize the joined batch from matched pair index arrays."""
+    l_cap, r_cap = left.capacity, right.capacity
     pair_cap = int(l_idx.shape[0])
     l_live = jnp.arange(l_cap, dtype=jnp.int32) < left.num_rows
     r_live = jnp.arange(r_cap, dtype=jnp.int32) < right.num_rows
@@ -296,20 +354,33 @@ def hash_join(left: ColumnBatch, left_keys: List[DevVal],
 def cross_join(left: ColumnBatch, right: ColumnBatch,
                out_schema: T.Schema) -> ColumnBatch:
     """Cartesian product (GpuCartesianProductExec analogue)."""
+    return nested_loop_join(left, right, "cross", None, out_schema)
+
+
+def nested_loop_join(left: ColumnBatch, right: ColumnBatch, join_type: str,
+                     condition, out_schema: T.Schema) -> ColumnBatch:
+    """All-pairs join with an optional condition — every join type
+    (GpuBroadcastNestedLoopJoinExec.scala:305: the reference runs outer /
+    semi NLJ on device too).  Matched pairs = cross pairs passing the
+    condition; unmatched rows null-pad per the join type."""
+    l_cap, r_cap = left.capacity, right.capacity
     n_l = int(jax.device_get(left.num_rows))
     n_r = int(jax.device_get(right.num_rows))
     total = n_l * n_r
-    out_cap = round_up_capacity(max(total, 1))
-    i = jnp.arange(out_cap, dtype=jnp.int32)
+    pair_cap = round_up_capacity(max(total, 1))
+    i = jnp.arange(pair_cap, dtype=jnp.int32)
     li = jnp.where(n_r > 0, i // max(n_r, 1), 0).astype(jnp.int32)
     ri = jnp.where(n_r > 0, i % max(n_r, 1), 0).astype(jnp.int32)
-    total_dev = jnp.asarray(total, jnp.int32)
-    live = i < total_dev
-    lcaps = _string_byte_caps(left, li, live)
-    rcaps = _string_byte_caps(right, ri, live)
-    lg = gather_rows(left, li, total_dev, out_capacity=out_cap,
-                     out_byte_caps=lcaps or None)
-    rg = gather_rows(right, ri, total_dev, out_capacity=out_cap,
-                     out_byte_caps=rcaps or None)
-    return ColumnBatch(out_schema, list(lg.columns) + list(rg.columns),
-                       total_dev, out_cap)
+    n_pairs = jnp.asarray(total, jnp.int32)
+    l_live = jnp.arange(l_cap, dtype=jnp.int32) < left.num_rows
+    r_live = jnp.arange(r_cap, dtype=jnp.int32) < right.num_rows
+    if condition is not None:
+        li, ri, n_pairs, l_counts, r_matched = _filter_pairs(
+            left, right, li, ri, n_pairs, condition)
+    else:
+        l_counts = jnp.where(l_live, n_r, 0).astype(jnp.int32)
+        r_matched = r_live & (n_l > 0)
+    if join_type == "cross":
+        join_type = "inner"
+    return stitch_join_output(left, right, li, ri, n_pairs, l_counts,
+                              r_matched, join_type, out_schema)

@@ -363,8 +363,11 @@ class CpuHashJoinExec(CpuExec):
         return [gen(lp, rp) for lp, rp in zip(lparts, rparts)]
 
     def _cond(self, row):
-        # Evaluate the residual condition over a single joined row.
-        sch = self.output_schema
+        # Evaluate the residual condition over a single joined row.  The
+        # condition can reference both sides even for semi/anti joins whose
+        # OUTPUT schema is left-only, so bind against left ++ right.
+        sch = T.Schema(list(self.children[0].output_schema.fields) +
+                       list(self.children[1].output_schema.fields))
         hb = _from_rows(sch, [row])
         v = self.condition.cpu_eval(CpuEvalCtx(hb))
         return bool(v.validity[0]) and bool(v.values[0])
@@ -381,6 +384,9 @@ class CpuNestedLoopJoinExec(CpuExec):
         self.condition = condition
 
     def num_partitions(self, ctx):
+        # right/full need one global pass over both sides
+        if self.how in ("right", "full"):
+            return 1
         return self.children[0].num_partitions(ctx)
 
     def partitions(self, ctx):
@@ -389,22 +395,61 @@ class CpuNestedLoopJoinExec(CpuExec):
         for p in self.children[1].partitions(ctx):
             for hb in p:
                 rrows.extend(_rows(hb))
+        lsch = self.children[0].output_schema
+        rsch = self.children[1].output_schema
+        l_nulls = tuple(None for _ in lsch.fields)
+        r_nulls = tuple(None for _ in rsch.fields)
+        lparts = self.children[0].partitions(ctx)
+
+        def matches_of(lrow):
+            return [(j, rrow) for j, rrow in enumerate(rrows)
+                    if self.condition is None or self._cond(lrow, rrow)]
 
         def gen(lp):
             out = []
             for hb in lp:
                 for lrow in _rows(hb):
-                    for rrow in rrows:
-                        row = lrow + rrow
-                        if self.condition is None or self._cond(row):
-                            out.append(row)
+                    ms = matches_of(lrow)
+                    if self.how == "left_semi":
+                        if ms:
+                            out.append(lrow)
+                    elif self.how == "left_anti":
+                        if not ms:
+                            out.append(lrow)
+                    elif ms:
+                        out.extend(lrow + rrow for _, rrow in ms)
+                    elif self.how == "left":
+                        out.append(lrow + r_nulls)
             if out:
                 yield _from_rows(self.output_schema, out)
 
-        return [gen(p) for p in self.children[0].partitions(ctx)]
+        if self.how in ("right", "full"):
+            def gen_all():
+                r_matched: set = set()
+                out = []
+                for part in lparts:
+                    for hb in part:
+                        for lrow in _rows(hb):
+                            ms = matches_of(lrow)
+                            r_matched.update(j for j, _ in ms)
+                            if ms:
+                                out.extend(lrow + rrow for _, rrow in ms)
+                            elif self.how == "full":
+                                out.append(lrow + r_nulls)
+                for j, rrow in enumerate(rrows):
+                    if j not in r_matched:
+                        out.append(l_nulls + rrow)
+                if out:
+                    yield _from_rows(self.output_schema, out)
 
-    def _cond(self, row):
-        hb = _from_rows(self.output_schema, [row])
+            return [gen_all()]
+        return [gen(p) for p in lparts]
+
+    def _cond(self, lrow, rrow):
+        lsch = self.children[0].output_schema
+        rsch = self.children[1].output_schema
+        sch = T.Schema(list(lsch.fields) + list(rsch.fields))
+        hb = _from_rows(sch, [lrow + rrow])
         v = self.condition.cpu_eval(CpuEvalCtx(hb))
         return bool(v.validity[0]) and bool(v.values[0])
 
@@ -445,3 +490,37 @@ class CpuSampleExec(CpuExec):
 
         return [gen(i, p)
                 for i, p in enumerate(self.children[0].partitions(ctx))]
+
+
+class CpuGenerateExec(CpuExec):
+    """explode/posexplode (+ outer) host fallback / oracle."""
+
+    def __init__(self, column: str, alias: str, pos: bool, outer: bool,
+                 child: PhysicalOp, schema: T.Schema):
+        super().__init__([child], schema)
+        self.column = column
+        self.alias = alias
+        self.pos = pos
+        self.outer = outer
+
+    def partitions(self, ctx):
+        def gen(part):
+            for hb in part:
+                ci = hb.schema.index_of(self.column)
+                cols = [c.to_list() for c in hb.columns]
+                out_rows = []
+                for r in range(hb.num_rows):
+                    row = tuple(c[r] for c in cols)
+                    arr = row[ci]
+                    rest = row[:ci] + row[ci + 1:]
+                    if arr:
+                        for p, e in enumerate(arr):
+                            out_rows.append(
+                                rest + ((p,) if self.pos else ()) + (e,))
+                    elif self.outer:
+                        out_rows.append(
+                            rest + ((None,) if self.pos else ()) + (None,))
+                if out_rows:
+                    yield _from_rows(self.output_schema, out_rows)
+
+        return [gen(p) for p in self.children[0].partitions(ctx)]

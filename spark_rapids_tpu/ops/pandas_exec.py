@@ -1,0 +1,215 @@
+"""Pandas-exec family: map / grouped-map / cogrouped-map / grouped-agg
+python execution over Arrow-shaped host batches.
+
+Reference analogues (sql-plugin/.../execution/python/):
+* GpuMapInPandasExec — :class:`CpuMapInPandasExec`
+* GpuFlatMapGroupsInPandasExec — :class:`CpuFlatMapGroupsInPandasExec`
+* GpuFlatMapCoGroupsInPandasExec — :class:`CpuFlatMapCoGroupsInPandasExec`
+* GpuAggregateInPandasExec — :class:`CpuAggregateInPandasExec`
+
+Like the reference, the engine side of these ops is data movement: device
+batches come back to host columnar form, python runs under the
+PythonWorkerSemaphore analogue (device semaphore released meanwhile), and
+results stage back to HBM via the planner's automatic transitions.  Python
+itself runs in-process (no out-of-process worker protocol; the semaphore
+plays that role — runtime/python_worker.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.batch import HostBatch, HostColumn
+from spark_rapids_tpu.plan.physical import CpuExec, ExecContext, PhysicalOp
+from spark_rapids_tpu.runtime.python_worker import python_worker_slot
+
+
+def _to_pandas(hb: HostBatch):
+    import pandas as pd
+    return pd.DataFrame(hb.to_pydict())
+
+
+def pandas_to_host_batch(pdf, schema: T.Schema) -> HostBatch:
+    cols = []
+    n = len(pdf)
+    for f in schema.fields:
+        if f.name not in pdf.columns:
+            raise ValueError(
+                f"pandas result is missing column {f.name!r}; has "
+                f"{list(pdf.columns)}")
+        s = pdf[f.name]
+        validity = ~s.isna().to_numpy() if n else np.zeros(0, dtype=bool)
+        if f.dtype.is_string:
+            values = np.array(
+                [("" if not ok else str(v))
+                 for v, ok in zip(s.tolist(), validity)], dtype=object)
+        else:
+            values = s.fillna(0).to_numpy().astype(f.dtype.np_dtype)
+        cols.append(HostColumn(f.dtype, values,
+                               np.asarray(validity, dtype=np.bool_)))
+    return HostBatch(schema, cols)
+
+
+class CpuMapInPandasExec(CpuExec):
+    """fn(Iterator[pd.DataFrame]) -> Iterator[pd.DataFrame], one call per
+    partition (pyspark mapInPandas semantics)."""
+
+    def __init__(self, fn: Callable, child: PhysicalOp, schema: T.Schema):
+        super().__init__([child], schema)
+        self.fn = fn
+
+    def describe(self):
+        return "CpuMapInPandas"
+
+    def partitions(self, ctx: ExecContext):
+        def gen(part):
+            def pdf_iter():
+                for hb in part:
+                    yield _to_pandas(hb)
+
+            with python_worker_slot(ctx):
+                for pdf in self.fn(pdf_iter()):
+                    hb = pandas_to_host_batch(pdf, self.output_schema)
+                    if hb.num_rows:
+                        yield hb
+
+        return [gen(p) for p in self.children[0].partitions(ctx)]
+
+
+class CpuFlatMapGroupsInPandasExec(CpuExec):
+    """Per-group fn(pd.DataFrame) -> pd.DataFrame after a hash exchange on
+    the grouping keys (child must be key-partitioned by the planner)."""
+
+    def __init__(self, key_names: List[str], fn: Callable, child: PhysicalOp,
+                 schema: T.Schema):
+        super().__init__([child], schema)
+        self.key_names = key_names
+        self.fn = fn
+
+    def describe(self):
+        return f"CpuFlatMapGroupsInPandas(keys={self.key_names})"
+
+    def partitions(self, ctx: ExecContext):
+        def gen(part):
+            batches = list(part)
+            if not batches:
+                return
+            pdf = _to_pandas(HostBatch.concat(batches))
+            outs = []
+            with python_worker_slot(ctx):
+                for _k, grp in pdf.groupby(self.key_names, dropna=False,
+                                           sort=True):
+                    outs.append(self.fn(grp))
+            for out in outs:
+                hb = pandas_to_host_batch(out, self.output_schema)
+                if hb.num_rows:
+                    yield hb
+
+        return [gen(p) for p in self.children[0].partitions(ctx)]
+
+
+class CpuFlatMapCoGroupsInPandasExec(CpuExec):
+    """Per-key fn(left_group_pdf, right_group_pdf) -> pd.DataFrame; both
+    sides hash-exchanged on their keys to co-partition."""
+
+    def __init__(self, left_names: List[str], right_names: List[str],
+                 fn: Callable, left: PhysicalOp, right: PhysicalOp,
+                 schema: T.Schema):
+        super().__init__([left, right], schema)
+        self.left_names = left_names
+        self.right_names = right_names
+        self.fn = fn
+
+    def describe(self):
+        return "CpuFlatMapCoGroupsInPandas"
+
+    def num_partitions(self, ctx):
+        return self.children[0].num_partitions(ctx)
+
+    def partitions(self, ctx: ExecContext):
+        import pandas as pd
+        lparts = self.children[0].partitions(ctx)
+        rparts = self.children[1].partitions(ctx)
+        assert len(lparts) == len(rparts)
+        lsch = self.children[0].output_schema
+        rsch = self.children[1].output_schema
+
+        def empty_pdf(schema):
+            return pd.DataFrame({
+                f.name: pd.Series([], dtype=object if f.dtype.is_string
+                                  else f.dtype.np_dtype)
+                for f in schema.fields})
+
+        def gen(lp, rp):
+            lbs, rbs = list(lp), list(rp)
+            lpdf = _to_pandas(HostBatch.concat(lbs)) if lbs else \
+                empty_pdf(lsch)
+            rpdf = _to_pandas(HostBatch.concat(rbs)) if rbs else \
+                empty_pdf(rsch)
+            lgroups = {k: g for k, g in lpdf.groupby(
+                self.left_names, dropna=False)} if len(lpdf) else {}
+            rgroups = {k: g for k, g in rpdf.groupby(
+                self.right_names, dropna=False)} if len(rpdf) else {}
+            keys = sorted(set(lgroups) | set(rgroups),
+                          key=lambda k: (str(k),))
+            outs = []
+            with python_worker_slot(ctx):
+                for k in keys:
+                    lg = lgroups.get(k, lpdf.iloc[0:0])
+                    rg = rgroups.get(k, rpdf.iloc[0:0])
+                    outs.append(self.fn(lg, rg))
+            for out in outs:
+                hb = pandas_to_host_batch(out, self.output_schema)
+                if hb.num_rows:
+                    yield hb
+
+        return [gen(lp, rp) for lp, rp in zip(lparts, rparts)]
+
+
+class CpuAggregateInPandasExec(CpuExec):
+    """One output row per group; each agg value is fn(pd.Series) over the
+    group's column (pyspark GROUPED_AGG pandas_udf shape)."""
+
+    def __init__(self, key_names: List[str], agg_specs, child: PhysicalOp,
+                 schema: T.Schema):
+        super().__init__([child], schema)
+        self.key_names = key_names
+        self.agg_specs = agg_specs  # (out_name, fn, dtype, col)
+
+    def describe(self):
+        return f"CpuAggregateInPandas(keys={self.key_names})"
+
+    def partitions(self, ctx: ExecContext):
+        def gen(part):
+            batches = list(part)
+            if not batches:
+                return
+            pdf = _to_pandas(HostBatch.concat(batches))
+            rows = []
+            with python_worker_slot(ctx):
+                for k, grp in pdf.groupby(self.key_names, dropna=False,
+                                          sort=True):
+                    key_vals = k if isinstance(k, tuple) else (k,)
+                    vals = [fn(grp[col])
+                            for _n, fn, _dt, col in self.agg_specs]
+                    rows.append(tuple(key_vals) + tuple(vals))
+            if not rows:
+                return
+            cols = []
+            for i, f in enumerate(self.output_schema.fields):
+                items = [r[i] for r in rows]
+                items = [None if _is_nan(x) else x for x in items]
+                cols.append(HostColumn.from_list(f.dtype, items))
+            yield HostBatch(self.output_schema, cols)
+
+        return [gen(p) for p in self.children[0].partitions(ctx)]
+
+
+def _is_nan(x) -> bool:
+    try:
+        return x is None or (isinstance(x, float) and x != x)
+    except TypeError:
+        return False

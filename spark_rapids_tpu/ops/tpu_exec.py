@@ -62,6 +62,18 @@ def shrink_to_fit(batch: ColumnBatch,
                        out_capacity=cap, out_byte_caps=byte_caps or None)
 
 
+def _reserve_for(ctx, batches: List[ColumnBatch], factor: int = 2) -> None:
+    """Budget headroom before a large concat/gather: ask the catalog to
+    evict lower-priority spillable batches so input + output fit
+    (SpillableColumnarBatch.scala:27 callers' reserve pattern)."""
+    if not batches:
+        return
+    from spark_rapids_tpu.mem.catalog import device_batch_bytes
+    from spark_rapids_tpu.runtime.device import DeviceRuntime
+    total = sum(device_batch_bytes(b) for b in batches)
+    DeviceRuntime.get(ctx.conf).catalog.reserve(factor * total)
+
+
 def _concat_all(batches: List[ColumnBatch], schema: T.Schema,
                 sizes: Optional[List[tuple]] = None
                 ) -> Optional[ColumnBatch]:
@@ -78,7 +90,8 @@ def _concat_all(batches: List[ColumnBatch], schema: T.Schema,
         sizes = host_sizes(batches)
     total_rows = sum(n for n, _ in sizes)
     cap = round_up_capacity(max(total_rows, 1))
-    n_str = sum(1 for f in schema.fields if f.dtype.is_string)
+    n_str = sum(1 for f in schema.fields
+                if f.dtype.is_string or f.dtype.is_array)
     byte_caps = [
         round_up_capacity(max(sum(s[1][j] for s in sizes), 16), minimum=16)
         for j in range(n_str)
@@ -245,6 +258,89 @@ class TpuCoalesceBatchesExec(TpuExec):
         return [gen(p) for p in self.children[0].partitions(ctx)]
 
 
+def _aqe_enabled(ctx) -> bool:
+    from spark_rapids_tpu.config import AQE_COALESCE_ENABLED
+    return AQE_COALESCE_ENABLED.get(ctx.conf)
+
+
+def _aqe_target_rows(ctx) -> int:
+    from spark_rapids_tpu.config import AQE_TARGET_ROWS
+    return AQE_TARGET_ROWS.get(ctx.conf)
+
+
+def _coalesce_partition_lists(parts: List[List[ColumnBatch]],
+                              sizes: List[int], target: int
+                              ) -> List[List[ColumnBatch]]:
+    """Group consecutive partitions until each group reaches target rows."""
+    groups, cur, cur_rows = [], [], 0
+    for pp, sz in zip(parts, sizes):
+        cur.extend(pp)
+        cur_rows += sz
+        if cur_rows >= target:
+            groups.append(cur)
+            cur, cur_rows = [], 0
+    if cur or not groups:
+        groups.append(cur)
+    return groups
+
+
+class TpuCoalescedShuffleReaderExec(TpuExec):
+    """AQE-style post-shuffle partition coalescing as a general plan
+    operator (GpuCustomShuffleReaderExec analogue): groups small
+    post-exchange partitions so each downstream task covers a worthwhile
+    row count.  The planner inserts it above exchanges feeding sort and
+    window; the hash aggregate and shuffled join coalesce inline (they
+    reuse the size fetch for output sizing)."""
+
+    def __init__(self, child: PhysicalOp):
+        super().__init__([child], child.output_schema)
+
+    def describe(self):
+        return "TpuCoalescedShuffleReader"
+
+    def pipeline_inline(self, ctx, build):
+        # inside one compiled program partitioning is virtual
+        return build(self.children[0])
+
+    def num_partitions(self, ctx):
+        return self.children[0].num_partitions(ctx)
+
+    def partitions(self, ctx):
+        import itertools
+        child = self.children[0]
+        lazy_parts = child.partitions(ctx)
+        if not _aqe_enabled(ctx) or len(lazy_parts) <= 1:
+            return lazy_parts
+        rows = getattr(child, "_last_part_rows", None)
+        if rows is not None and len(rows) == len(lazy_parts):
+            # spill-friendly path: sizes came with the shuffle (no unspill
+            # just to count rows); chain the lazy generators per group
+            target = _aqe_target_rows(ctx)
+            groups, cur, cur_rows = [], [], 0
+            for p, sz in zip(lazy_parts, rows):
+                cur.append(p)
+                cur_rows += sz
+                if cur_rows >= target:
+                    groups.append(cur)
+                    cur, cur_rows = [], 0
+            if cur or not groups:
+                groups.append(cur)
+            ctx.metric(self.op_id, "coalescedTo").add(len(groups))
+            return [itertools.chain(*g) for g in groups]
+        parts = [list(p) for p in lazy_parts]
+        from spark_rapids_tpu.batch import host_sizes
+        flat = [b for p in parts for b in p]
+        if not flat:
+            return [iter([])]
+        flat_sizes = host_sizes(flat)
+        by_id = {id(b): s[0] for b, s in zip(flat, flat_sizes)}
+        sizes = [sum(by_id[id(b)] for b in p) for p in parts]
+        groups = _coalesce_partition_lists(parts, sizes,
+                                           _aqe_target_rows(ctx))
+        ctx.metric(self.op_id, "coalescedTo").add(len(groups))
+        return [iter(g) for g in groups]
+
+
 class TpuFusedMapExec(TpuExec):
     """A chain of map-like stages (project/filter) compiled as ONE XLA
     program per batch.  Collapsing dispatch count matters doubly on TPU:
@@ -357,7 +453,9 @@ class TpuSortExec(TpuExec):
 
     def partitions(self, ctx):
         def gen(part):
-            merged = _concat_all(list(part), self.output_schema)
+            batches = list(part)
+            _reserve_for(ctx, batches)
+            merged = _concat_all(batches, self.output_schema)
             if merged is not None:
                 yield self._run(merged)
 
@@ -439,11 +537,12 @@ class TpuHashAggregateExec(TpuExec):
             for fn in self._input_fns:  # absorbed map stages
                 batches = [fn(b) for b in batches]
             if self.mode == "update":
-                partials = [self._aggregate_batch(b) for b in batches]
-                if len(partials) <= 1:
-                    return partials
-                merged = concat_static(partials, self.output_schema)
-                return [self._merge_partials(merged)]
+                # Emit per-batch partials as stage outputs: the stage break
+                # re-buckets them to live size (one sizes sync), so the
+                # downstream merge sorts a few thousand rows — merging here
+                # would concat at FULL padded capacity and sort O(sum of
+                # input caps) rows inside the program (seconds at 16M).
+                return [self._aggregate_batch(b) for b in batches]
             if not batches:
                 if self.key_exprs:
                     return []
@@ -529,37 +628,48 @@ class TpuHashAggregateExec(TpuExec):
             # role): post-shuffle partitions are often tiny; group small
             # ones so one compiled merge covers a worthwhile row count and
             # downstream sees fewer partitions.
-            parts = [list(p) for p in self.children[0].partitions(ctx)]
-            from spark_rapids_tpu.batch import host_sizes
-            all_sizes: dict = {}
-            if ctx.conf.get(
-                    "spark.rapids.sql.adaptive.coalescePartitions.enabled",
-                    True) not in (False, "false") and len(parts) > 1:
-                target = int(ctx.conf.get(
-                    "spark.rapids.sql.adaptive.targetPartitionRows",
-                    1 << 16))
-                # one round trip for every batch's sizes across ALL
-                # partitions (row counts + string byte totals), reused by
-                # the concat below
-                flat = [b for p in parts for b in p]
-                flat_sizes = host_sizes(flat) if flat else []
-                all_sizes = {id(b): s for b, s in zip(flat, flat_sizes)}
-                sizes = [sum(all_sizes[id(b)][0] for b in p) for p in parts]
-                groups, cur, cur_rows = [], [], 0
-                for pp, sz in zip(parts, sizes):
-                    cur.extend(pp)
-                    cur_rows += sz
-                    if cur_rows >= target:
-                        groups.append(cur)
-                        cur, cur_rows = [], 0
-                if cur or not groups:
-                    groups.append(cur)
-                parts = groups
+            import itertools
 
-            def gen(batches):
+            from spark_rapids_tpu.batch import host_sizes
+            child = self.children[0]
+            lazy_parts = child.partitions(ctx)
+            all_sizes: dict = {}
+            if _aqe_enabled(ctx) and len(lazy_parts) > 1:
+                target = _aqe_target_rows(ctx)
+                rows = getattr(child, "_last_part_rows", None)
+                if rows is not None and len(rows) == len(lazy_parts):
+                    # spill-friendly: shuffle-known sizes, lazy chaining
+                    groups, cur, cur_rows = [], [], 0
+                    for p, sz in zip(lazy_parts, rows):
+                        cur.append(p)
+                        cur_rows += sz
+                        if cur_rows >= target:
+                            groups.append(cur)
+                            cur, cur_rows = [], 0
+                    if cur or not groups:
+                        groups.append(cur)
+                    parts = [itertools.chain(*g) for g in groups]
+                else:
+                    mats = [list(p) for p in lazy_parts]
+                    # one round trip for every batch's sizes across ALL
+                    # partitions (row counts + string byte totals), reused
+                    # by the concat below
+                    flat = [b for p in mats for b in p]
+                    flat_sizes = host_sizes(flat) if flat else []
+                    all_sizes = {id(b): s
+                                 for b, s in zip(flat, flat_sizes)}
+                    sizes = [sum(all_sizes[id(b)][0] for b in p)
+                             for p in mats]
+                    parts = _coalesce_partition_lists(mats, sizes, target)
+            else:
+                parts = lazy_parts
+
+            def gen(part):
+                batches = list(part)
                 pre = [all_sizes[id(b)] for b in batches] \
                     if batches and all(id(b) in all_sizes for b in batches) \
                     else None
+                _reserve_for(ctx, batches)
                 merged = _concat_all(batches, child_schema, sizes=pre)
                 if merged is None:
                     if self.key_exprs:
@@ -642,13 +752,53 @@ class TpuShuffledHashJoinExec(TpuExec):
         return self.children[0].num_partitions(ctx)
 
     def partitions(self, ctx):
-        lparts = self.children[0].partitions(ctx)
-        rparts = self.children[1].partitions(ctx)
+        import itertools
+        lchild, rchild = self.children
+        lparts = lchild.partitions(ctx)
+        rparts = rchild.partitions(ctx)
         assert len(lparts) == len(rparts)
 
+        if _aqe_enabled(ctx) and len(lparts) > 1:
+            # AQE pair coalescing: group co-partitioned (left, right) pairs
+            # by COMBINED row count so both sides stay aligned
+            # (GpuCustomShuffleReaderExec role for joins).
+            lrows = getattr(lchild, "_last_part_rows", None)
+            rrows = getattr(rchild, "_last_part_rows", None)
+            if lrows is not None and rrows is not None and \
+                    len(lrows) == len(lparts) == len(rrows):
+                # spill-friendly: shuffle-known sizes, lazy chaining (each
+                # group's pieces unspill only when that pair is joined)
+                sizes = [a + b for a, b in zip(lrows, rrows)]
+            else:
+                lparts = [list(p) for p in lparts]
+                rparts = [list(p) for p in rparts]
+                from spark_rapids_tpu.batch import host_sizes
+                flat = [b for p in lparts + rparts for b in p]
+                by_id = {id(b): s[0]
+                         for b, s in zip(flat, host_sizes(flat))} \
+                    if flat else {}
+                sizes = [sum(by_id[id(b)] for b in lp) +
+                         sum(by_id[id(b)] for b in rp)
+                         for lp, rp in zip(lparts, rparts)]
+            target = _aqe_target_rows(ctx)
+            groups, cur_l, cur_r, cur_rows = [], [], [], 0
+            for lp, rp, sz in zip(lparts, rparts, sizes):
+                cur_l.append(lp)
+                cur_r.append(rp)
+                cur_rows += sz
+                if cur_rows >= target:
+                    groups.append((cur_l, cur_r))
+                    cur_l, cur_r, cur_rows = [], [], 0
+            if cur_l or cur_r or not groups:
+                groups.append((cur_l, cur_r))
+            lparts = [itertools.chain(*g[0]) for g in groups]
+            rparts = [itertools.chain(*g[1]) for g in groups]
+
         def gen(lp, rp):
-            lb = _concat_all(list(lp), self.children[0].output_schema)
-            rb = _concat_all(list(rp), self.children[1].output_schema)
+            lbs, rbs = list(lp), list(rp)
+            _reserve_for(ctx, lbs + rbs)
+            lb = _concat_all(lbs, self.children[0].output_schema)
+            rb = _concat_all(rbs, self.children[1].output_schema)
             out = self._join_pair(lb, rb)
             if out is not None:
                 yield out
@@ -674,45 +824,62 @@ class TpuShuffledHashJoinExec(TpuExec):
         rctx = TpuEvalCtx(rb)
         lkeys = [e.tpu_eval(lctx) for e in self.left_keys]
         rkeys = [e.tpu_eval(rctx) for e in self.right_keys]
-        out = hash_join(lb, lkeys, rb, rkeys, self.how, self.output_schema)
-        if self.condition is not None:
-            cctx = TpuEvalCtx(out)
-            v = self.condition.tpu_eval(cctx)
-            out = compact(out, v.validity & v.data.astype(jnp.bool_))
-        return out
+        # the residual condition runs INSIDE the join (it gates matches
+        # before null-padding — GpuHashJoin.scala:265-271), so outer and
+        # semi/anti joins with conditions are correct on device
+        return hash_join(lb, lkeys, rb, rkeys, self.how, self.output_schema,
+                         condition=self.condition)
 
 
 class TpuNestedLoopJoinExec(TpuExec):
-    """Cross join with optional condition-as-filter (inner/cross only);
-    right side broadcast-materialized (GpuBroadcastNestedLoopJoinExec +
+    """All-pairs join with optional condition, every join type; right side
+    broadcast-materialized (GpuBroadcastNestedLoopJoinExec.scala:305 +
     GpuCartesianProductExec analogue)."""
 
-    def __init__(self, left: PhysicalOp, right: PhysicalOp,
+    def __init__(self, left: PhysicalOp, right: PhysicalOp, how: str,
                  condition: Optional[Expression], schema: T.Schema):
         super().__init__([left, right], schema)
+        self.how = how
         self.condition = condition
 
+    def describe(self):
+        return f"TpuNestedLoopJoin({self.how})"
+
     def num_partitions(self, ctx):
+        if self.how in ("right", "full"):
+            return 1
         return self.children[0].num_partitions(ctx)
 
     def partitions(self, ctx):
+        from spark_rapids_tpu.kernels.join import nested_loop_join
         rbatches = []
         for p in self.children[1].partitions(ctx):
             rbatches.extend(p)
         rb = _concat_all(rbatches, self.children[1].output_schema)
+        lparts = self.children[0].partitions(ctx)
+        lsch = self.children[0].output_schema
+        rsch = self.children[1].output_schema
+
+        if self.how in ("right", "full"):
+            # right-unmatched rows are a property of the WHOLE left side:
+            # run one global all-pairs join
+            def gen_all():
+                lbatches = [b for p in lparts for b in p]
+                lb = _concat_all(lbatches, lsch) or empty_device_batch(lsch)
+                rb_local = rb if rb is not None else \
+                    empty_device_batch(rsch)
+                yield nested_loop_join(lb, rb_local, self.how,
+                                       self.condition, self.output_schema)
+
+            return [gen_all()]
 
         def gen(lp):
+            rb_local = rb if rb is not None else empty_device_batch(rsch)
             for lb in lp:
-                if rb is None:
-                    return
-                out = cross_join(lb, rb, self.output_schema)
-                if self.condition is not None:
-                    cctx = TpuEvalCtx(out)
-                    v = self.condition.tpu_eval(cctx)
-                    out = compact(out, v.validity & v.data.astype(jnp.bool_))
-                yield out
+                yield nested_loop_join(lb, rb_local, self.how,
+                                       self.condition, self.output_schema)
 
-        return [gen(p) for p in self.children[0].partitions(ctx)]
+        return [gen(p) for p in lparts]
 
 
 class TpuExpandExec(TpuExec):
@@ -871,12 +1038,73 @@ class TpuBroadcastHashJoinExec(TpuExec):
                 rctx = TpuEvalCtx(rb)
                 lkeys = [e.tpu_eval(lctx) for e in self.left_keys]
                 rkeys = [e.tpu_eval(rctx) for e in self.right_keys]
-                out = hash_join(lb, lkeys, rb, rkeys, self.how,
-                                self.output_schema)
-                if self.condition is not None:
-                    cctx = TpuEvalCtx(out)
-                    v = self.condition.tpu_eval(cctx)
-                    out = compact(out, v.validity & v.data.astype(jnp.bool_))
-                yield out
+                yield hash_join(lb, lkeys, rb, rkeys, self.how,
+                                self.output_schema,
+                                condition=self.condition)
+
+        return [gen(p) for p in self.children[0].partitions(ctx)]
+
+
+class TpuGenerateExec(TpuExec):
+    """explode/posexplode of a fixed-width-element array column
+    (GpuGenerateExec analogue, GpuGenerateExec.scala): one flat-position →
+    parent-row mapping (searchsorted over the array offsets) drives a
+    whole-row gather of the kept columns; the element buffer IS the new
+    column.  Output capacity = the array column's element capacity
+    (static); live rows = total elements (device scalar — no host sync)."""
+
+    def __init__(self, column: str, alias: str, pos: bool,
+                 child: PhysicalOp, schema: T.Schema):
+        super().__init__([child], schema)
+        self.column = column
+        self.alias = alias
+        self.pos = pos
+
+    def describe(self):
+        kind = "posexplode" if self.pos else "explode"
+        return f"TpuGenerate({kind}({self.column}))"
+
+    def _explode_batch(self, batch: ColumnBatch) -> ColumnBatch:
+        from spark_rapids_tpu.exprs.strings import rows_of_positions
+        child_schema = batch.schema
+        ci = child_schema.index_of(self.column)
+        arr = batch.columns[ci]
+        elem_cap = int(arr.data.shape[0])
+        total = arr.offsets[batch.num_rows].astype(jnp.int32)
+        live = jnp.arange(elem_cap, dtype=jnp.int32) < total
+        parent = jnp.clip(rows_of_positions(arr.offsets, elem_cap),
+                          0, batch.capacity - 1)
+        kept = [i for i in range(len(child_schema)) if i != ci]
+        kept_schema = T.Schema([child_schema.fields[i] for i in kept])
+        kept_batch = ColumnBatch(kept_schema,
+                                 [batch.columns[i] for i in kept],
+                                 batch.num_rows, batch.capacity)
+        # string columns can EXPAND (parent rows repeat); size on host
+        bcaps = []
+        for i in kept:
+            c = batch.columns[i]
+            if c.is_varlen:
+                lens = (c.offsets[1:] - c.offsets[:-1]).astype(jnp.int64)
+                tot = jnp.sum(jnp.where(live, lens[parent], 0))
+                bcaps.append(round_up_capacity(
+                    max(int(jax.device_get(tot)), 16), minimum=16))
+        g = gather_rows(kept_batch, parent, total, out_capacity=elem_cap,
+                        out_byte_caps=bcaps or None)
+        cols = list(g.columns)
+        if self.pos:
+            pos_col = jnp.arange(elem_cap, dtype=jnp.int32) - \
+                arr.offsets[parent]
+            cols.append(DeviceColumn(
+                T.INT, jnp.where(live, pos_col, 0), live, None))
+        elem_valid = live & arr.validity[parent]
+        cols.append(DeviceColumn(self.output_schema.fields[-1].dtype,
+                                 jnp.where(live, arr.data, 0),
+                                 elem_valid, None))
+        return ColumnBatch(self.output_schema, cols, total, elem_cap)
+
+    def partitions(self, ctx):
+        def gen(part):
+            for db in part:
+                yield self._explode_batch(db)
 
         return [gen(p) for p in self.children[0].partitions(ctx)]

@@ -82,7 +82,7 @@ class _Segments:
         self.seg_ids = jnp.clip(seg_ids, 0, cap - 1)
         n_live = jnp.sum(live.astype(jnp.int32))
         seg_len = jax.ops.segment_sum(live.astype(jnp.int32), self.seg_ids,
-                                      num_segments=cap)
+                                      num_segments=cap, indices_are_sorted=True)
         self.seg_end_pos = self.seg_start_pos + \
             jnp.maximum(seg_len[self.seg_ids] - 1, 0)
         # peers: change = seg_start | order-key change

@@ -264,7 +264,15 @@ class TpuShuffleExchangeExec(TpuExec):
             flat = [b for part in all_batches for b in part]
             return [iter(flat)]
         from spark_rapids_tpu.batch import round_up_capacity
-        out: List[List[ColumnBatch]] = [[] for _ in range(n)]
+        from spark_rapids_tpu.mem.catalog import PRIORITY_SHUFFLE_OUTPUT
+        from spark_rapids_tpu.runtime.device import DeviceRuntime
+        # Shuffle outputs accumulate across ALL partitions before any
+        # consumer runs — exactly the working set the reference keeps in the
+        # spillable shuffle catalog (RapidsShuffleInternalManager.scala:
+        # 91-154, ShuffleBufferCatalog).  Register every piece so the budget
+        # can push early partitions to host while later ones materialize.
+        catalog = DeviceRuntime.get(ctx.conf).catalog
+        out: List[List] = [[] for _ in range(n)]
         for pi, batches in enumerate(all_batches):
             for db in batches:
                 sorted_batch, counts, byte_totals = \
@@ -291,9 +299,24 @@ class TpuShuffleExchangeExec(TpuExec):
                                     jnp.asarray(cnt, jnp.int32),
                                     out_capacity=pcap,
                                     out_byte_caps=bcaps or None)
-                    out[p].append(piece)
+                    h = catalog.register(piece, PRIORITY_SHUFFLE_OUTPUT)
+                    h.piece_rows = cnt  # host-known: no sync for AQE sizing
+                    out[p].append(h)
                     offset += cnt
-        return [iter(p) for p in out]
+
+        # downstream AQE coalescing reads these instead of unspilling
+        # batches just to count rows (GpuCustomShuffleReaderExec's use of
+        # map-status sizes)
+        self._last_part_rows = [sum(h.piece_rows for h in p) for p in out]
+
+        def drain(handles):
+            # lazy: each piece unspills only when the consumer reaches it
+            for h in handles:
+                b = h.get()
+                h.close()
+                yield b
+
+        return [drain(p) for p in out]
 
 
 def _mesh_partitioning(p: Partitioning, n: int) -> Partitioning:

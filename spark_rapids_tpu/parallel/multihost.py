@@ -1,0 +1,78 @@
+"""Multi-host process groups: the DCN channel of the engine's distributed
+story (SURVEY.md section 2.7 — the role RapidsShuffleManager's UCX/DCN
+transport + executor discovery play for the reference).
+
+TPU-first shape: there is no custom transport to write.  Each host runs one
+process; ``jax.distributed.initialize`` forms the process group over the
+coordinator, ``jax.devices()`` then spans EVERY host's chips, and the same
+``jax.sharding.Mesh`` + ``lax.all_to_all`` exchange the engine already uses
+single-host (``parallel.mesh_shuffle``) rides ICI within a slice and DCN
+across slices — XLA picks the fabric per edge, no NCCL/MPI analogue needed.
+
+Config keys mirror the deployment story:
+  spark.rapids.multihost.coordinator   host:port of process 0
+  spark.rapids.multihost.numProcesses  world size
+  spark.rapids.multihost.processId    this process's rank
+
+``init_multihost`` is idempotent and a no-op for world size 1 (the
+single-process development mode every test runs in).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from spark_rapids_tpu.config import RapidsConf, conf_int, conf_str
+
+MULTIHOST_COORDINATOR = conf_str(
+    "spark.rapids.multihost.coordinator", "",
+    "host:port of the rank-0 coordinator for multi-host execution; empty "
+    "means single-process mode.")
+MULTIHOST_NUM_PROCESSES = conf_int(
+    "spark.rapids.multihost.numProcesses", 1,
+    "World size of the multi-host process group.")
+MULTIHOST_PROCESS_ID = conf_int(
+    "spark.rapids.multihost.processId", 0,
+    "This process's rank in the multi-host group.")
+
+_initialized = False
+
+
+def init_multihost(conf: Optional[RapidsConf] = None,
+                   coordinator: Optional[str] = None,
+                   num_processes: Optional[int] = None,
+                   process_id: Optional[int] = None) -> bool:
+    """Join the process group (idempotent).  Returns True if a >1-process
+    group is active after the call.
+
+    After initialization ``jax.devices()`` lists every host's chips, so
+    ``mesh_shuffle.make_mesh()`` builds a global mesh and the engine's
+    exchange runs across hosts unchanged.
+    """
+    global _initialized
+    conf = conf or RapidsConf()
+    coordinator = coordinator or MULTIHOST_COORDINATOR.get(conf)
+    num_processes = num_processes or MULTIHOST_NUM_PROCESSES.get(conf)
+    process_id = process_id if process_id is not None \
+        else MULTIHOST_PROCESS_ID.get(conf)
+    if not coordinator or num_processes <= 1:
+        return False
+    if _initialized:
+        return True
+    import jax
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _initialized = True
+    return True
+
+
+def world_info() -> dict:
+    """(process_count, process_index, device counts) for observability."""
+    import jax
+    return {
+        "process_count": jax.process_count(),
+        "process_index": jax.process_index(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }

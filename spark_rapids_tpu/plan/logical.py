@@ -61,12 +61,16 @@ class FileScan(LogicalPlan):
 
     def __init__(self, fmt: str, paths: List[str], schema: T.Schema,
                  options: Optional[Dict[str, Any]] = None,
-                 pushed_filters: Optional[List[Expression]] = None):
+                 pushed_filters: Optional[List[Expression]] = None,
+                 partitions=None):
         self.fmt = fmt
         self.paths = paths
         self._schema = schema
         self.options = options or {}
         self.pushed_filters = pushed_filters or []
+        # Hive-layout partition columns: (partition_schema,
+        # {file: [values...]}) — appended as constants per file by the scan
+        self.partitions = partitions
         self.children = ()
 
     @property
@@ -74,7 +78,9 @@ class FileScan(LogicalPlan):
         return self._schema
 
     def describe(self):
-        return f"FileScan({self.fmt}, {len(self.paths)} files)"
+        extra = f", pushed={len(self.pushed_filters)}" \
+            if self.pushed_filters else ""
+        return f"FileScan({self.fmt}, {len(self.paths)} files{extra})"
 
 
 class Range(LogicalPlan):
@@ -373,3 +379,154 @@ class BroadcastHint(LogicalPlan):
     @property
     def schema(self):
         return self.children[0].schema
+
+
+class MapInPandas(LogicalPlan):
+    """mapInPandas(fn, schema): fn(Iterator[pd.DataFrame]) ->
+    Iterator[pd.DataFrame] per partition (GpuMapInPandasExec analogue)."""
+
+    def __init__(self, fn, schema: T.Schema, child: LogicalPlan):
+        self.fn = fn
+        self._schema = schema
+        self.children = (child,)
+
+    @property
+    def schema(self):
+        return self._schema
+
+
+class FlatMapGroupsInPandas(LogicalPlan):
+    """groupBy(...).applyInPandas(fn, schema)
+    (GpuFlatMapGroupsInPandasExec analogue)."""
+
+    def __init__(self, keys: List[Expression], key_names: List[str], fn,
+                 schema: T.Schema, child: LogicalPlan):
+        self.keys = keys
+        self.key_names = key_names
+        self.fn = fn
+        self._schema = schema
+        self.children = (child,)
+
+    @property
+    def schema(self):
+        return self._schema
+
+
+class FlatMapCoGroupsInPandas(LogicalPlan):
+    """a.groupBy(k).cogroup(b.groupBy(k)).applyInPandas(fn, schema)
+    (GpuFlatMapCoGroupsInPandasExec analogue)."""
+
+    def __init__(self, left_keys, left_names, right_keys, right_names, fn,
+                 schema: T.Schema, left: LogicalPlan, right: LogicalPlan):
+        self.left_keys = left_keys
+        self.left_names = left_names
+        self.right_keys = right_keys
+        self.right_names = right_names
+        self.fn = fn
+        self._schema = schema
+        self.children = (left, right)
+
+    @property
+    def schema(self):
+        return self._schema
+
+
+class AggregateInPandas(LogicalPlan):
+    """groupBy(...).agg_in_pandas({out: (fn, dtype, col)}): one output row
+    per group, values computed by python over each group's pandas Series
+    (GpuAggregateInPandasExec analogue)."""
+
+    def __init__(self, keys: List[Expression], key_names: List[str],
+                 agg_specs, child: LogicalPlan):
+        self.keys = keys
+        self.key_names = key_names
+        self.agg_specs = agg_specs  # list of (out_name, fn, dtype, col)
+        self.children = (child,)
+
+    @property
+    def schema(self):
+        fields = [T.Field(n, e.dtype, e.nullable)
+                  for n, e in zip(self.key_names, self.keys)]
+        fields += [T.Field(n, dt, True) for n, _fn, dt, _c in self.agg_specs]
+        return T.Schema(fields)
+
+
+def plan_fingerprint(plan: LogicalPlan) -> str:
+    """Canonical identity of a logical plan for physical-plan reuse.
+
+    Built from node types + their scalar/expression attributes; objects
+    without stable reprs (user fns, batch lists) key by python identity —
+    collisions are impossible (identity reprs are unique), only *misses*
+    for structurally equal but distinct-object inputs, which is safe.
+    """
+    from spark_rapids_tpu.exprs.base import Expression, SortOrder
+
+    def enc(v):
+        if isinstance(v, AggregateExpression):
+            return f"AE({v.output_name},{enc(v.fn)})"
+        if isinstance(v, Expression):
+            # NOT repr(): Expression.__repr__ prints only class + children,
+            # omitting scalar attributes (ConcatWs.sep, Lag.offset,
+            # window frames...) — encode every non-child attribute too so
+            # structurally different expressions never collide.
+            parts = [type(v).__name__]
+            for k, a in sorted(vars(v).items()):
+                if k == "children":
+                    continue
+                parts.append(f"{k}={enc(a)}")
+            kids = ",".join(enc(c) for c in v.children)
+            return f"{'|'.join(parts)}({kids})"
+        if isinstance(v, SortOrder):
+            return (f"SO({enc(v.child)},{v.ascending},{v.nulls_first})")
+        if isinstance(v, (str, int, float, bool, type(None))):
+            return repr(v)
+        if isinstance(v, T.Schema):
+            return str(v)
+        if isinstance(v, T.DataType):
+            return str(v)
+        if isinstance(v, (list, tuple)):
+            return "[" + ",".join(enc(x) for x in v) + "]"
+        if isinstance(v, dict):
+            return "{" + ",".join(
+                f"{enc(k)}:{enc(x)}" for k, x in sorted(
+                    v.items(), key=lambda kv: str(kv[0]))) + "}"
+        return f"id:{id(v):x}"  # fns, batch lists, cache holders...
+
+    attrs = []
+    for k, v in sorted(vars(plan).items()):
+        if k in ("children", "_schema"):
+            continue
+        attrs.append(f"{k}={enc(v)}")
+    kids = ",".join(plan_fingerprint(c) for c in plan.children)
+    return f"{plan.name}({';'.join(attrs)})[{kids}]"
+
+
+class Generate(LogicalPlan):
+    """Generator expansion: explode/posexplode of an array column
+    (GpuGenerateExec analogue, GpuGenerateExec.scala).  Output = the
+    child's other columns repeated per element (+ optional ``pos``) + the
+    element column.  ``outer`` keeps empty/NULL-array rows with a NULL
+    element (CPU path)."""
+
+    def __init__(self, column: str, alias: str, pos: bool, outer: bool,
+                 child: LogicalPlan):
+        self.column = column
+        self.alias = alias
+        self.pos = pos
+        self.outer = outer
+        self.children = (child,)
+
+    @property
+    def schema(self):
+        child = self.children[0].schema
+        arr = child.field(self.column)
+        assert arr.dtype.is_array, f"explode needs an array, got {arr.dtype}"
+        fields = [f for f in child.fields if f.name != self.column]
+        if self.pos:
+            fields.append(T.Field("pos", T.INT, False))
+        fields.append(T.Field(self.alias, arr.dtype.element, self.outer))
+        return T.Schema(fields)
+
+    def describe(self):
+        kind = "posexplode" if self.pos else "explode"
+        return f"Generate({kind}({self.column}) as {self.alias})"

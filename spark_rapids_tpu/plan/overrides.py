@@ -128,6 +128,17 @@ class TpuOverrides:
         if conf.get(key, True) in (False, "false"):
             meta.will_not_work(f"disabled by {key}")
 
+        # nested-type gating: array columns ride the varlen device layout
+        # but only project/filter/explode consume them on TPU (the reference
+        # gates nested types per-op the same way, GpuOverrides.scala:397-409)
+        if not isinstance(node, (L.Project, L.Filter, L.Generate,
+                                 L.InMemoryScan, L.FileScan, L.Union,
+                                 L.Limit, L.CachedRelation)):
+            schemas = [c.schema for c in node.children]
+            if any(f.dtype.is_array for s in schemas for f in s.fields):
+                meta.will_not_work(
+                    "array columns: only project/filter/explode run on TPU")
+
         if isinstance(node, (L.InMemoryScan, L.FileScan)):
             # Scans decode on host by design (SURVEY.md section 7: host Arrow
             # decode staged into HBM); they are CPU execs + HostToDevice.
@@ -156,10 +167,9 @@ class TpuOverrides:
         elif isinstance(node, L.Join):
             meta.check_exprs(*node.left_keys, *node.right_keys)
             if node.condition is not None:
+                # conditions gate matches inside the join kernel for every
+                # join type (GpuHashJoin.scala:265-271 parity)
                 meta.check_exprs(node.condition)
-                if node.how not in ("inner", "cross"):
-                    meta.will_not_work(
-                        f"{node.how} join with residual condition")
         elif isinstance(node, L.Expand):
             for proj in node.projections:
                 meta.check_exprs(*proj)
@@ -171,12 +181,28 @@ class TpuOverrides:
         elif isinstance(node, L.Repartition):
             for k in node.keys:
                 meta.check_exprs(k)
+        elif isinstance(node, L.Generate):
+            if node.outer:
+                meta.will_not_work(
+                    "explode_outer emits NULL-element rows (CPU path)")
+            arr = node.children[0].schema.field(node.column)
+            if not arr.dtype.is_array:
+                meta.will_not_work(f"explode needs an array, got {arr.dtype}")
+        elif isinstance(node, (L.MapInPandas, L.FlatMapGroupsInPandas,
+                               L.FlatMapCoGroupsInPandas,
+                               L.AggregateInPandas)):
+            meta.will_not_work(
+                "pandas exec runs python via the host Arrow path "
+                "(GpuArrowEvalPythonExec data flow)")
 
     # -------------------------------------------------------------- convert
 
     def apply(self, plan: L.LogicalPlan) -> PhysicalOp:
         if self.conf.get("spark.rapids.sql.udfCompiler.enabled", False):
             plan = _compile_plan_udfs(plan)
+        if self.conf.get("spark.rapids.sql.scan.pushdown.enabled", True) \
+                not in (False, "false"):
+            plan = _pushdown_scan_filters(plan)
         meta = PlanMeta(plan, self.conf)
         self.tag(meta)
         self.last_explain = "\n".join(meta.explain_lines())
@@ -260,6 +286,47 @@ class TpuOverrides:
             if on_tpu:
                 return TpuShuffleExchangeExec(part, conv[0])
             return CpuShuffleExchangeExec(part, conv[0])
+        if isinstance(node, L.Generate):
+            if on_tpu:
+                return X.TpuGenerateExec(node.column, node.alias, node.pos,
+                                         _to_device(conv[0]), node.schema)
+            return C.CpuGenerateExec(node.column, node.alias, node.pos,
+                                     node.outer, _to_host(conv[0]),
+                                     node.schema)
+        if isinstance(node, L.MapInPandas):
+            from spark_rapids_tpu.ops.pandas_exec import CpuMapInPandasExec
+            return CpuMapInPandasExec(node.fn, _to_host(conv[0]),
+                                      node.schema)
+        if isinstance(node, L.FlatMapGroupsInPandas):
+            from spark_rapids_tpu.ops.pandas_exec import (
+                CpuFlatMapGroupsInPandasExec,
+            )
+            part = HashPartitioning(node.keys, self._shuffle_parts())
+            ex = CpuShuffleExchangeExec(part, _to_host(conv[0]))
+            return CpuFlatMapGroupsInPandasExec(node.key_names, node.fn, ex,
+                                                node.schema)
+        if isinstance(node, L.FlatMapCoGroupsInPandas):
+            from spark_rapids_tpu.ops.pandas_exec import (
+                CpuFlatMapCoGroupsInPandasExec,
+            )
+            n_parts = self._shuffle_parts()
+            lex = CpuShuffleExchangeExec(
+                HashPartitioning(node.left_keys, n_parts),
+                _to_host(conv[0]))
+            rex = CpuShuffleExchangeExec(
+                HashPartitioning(node.right_keys, n_parts),
+                _to_host(conv[1]))
+            return CpuFlatMapCoGroupsInPandasExec(
+                node.left_names, node.right_names, node.fn, lex, rex,
+                node.schema)
+        if isinstance(node, L.AggregateInPandas):
+            from spark_rapids_tpu.ops.pandas_exec import (
+                CpuAggregateInPandasExec,
+            )
+            part = HashPartitioning(node.keys, self._shuffle_parts())
+            ex = CpuShuffleExchangeExec(part, _to_host(conv[0]))
+            return CpuAggregateInPandasExec(node.key_names, node.agg_specs,
+                                            ex, node.schema)
         if isinstance(node, L.Window):
             from spark_rapids_tpu.ops.window import (
                 CpuWindowExec, TpuWindowExec,
@@ -269,7 +336,8 @@ class TpuOverrides:
                                     self._shuffle_parts()) \
                 if w0.partition_by else SinglePartitioning()
             if on_tpu:
-                ex = TpuShuffleExchangeExec(part, _to_device(conv[0]))
+                ex = X.TpuCoalescedShuffleReaderExec(
+                    TpuShuffleExchangeExec(part, _to_device(conv[0])))
                 return TpuWindowExec(node.window_exprs, node.output_names,
                                      ex, node.schema)
             ex = CpuShuffleExchangeExec(part, _to_host(conv[0]))
@@ -367,7 +435,8 @@ class TpuOverrides:
         if node.is_global:
             part = RangePartitioning(orders, key_ordinals,
                                      self._shuffle_parts())
-            child = TpuShuffleExchangeExec(part, _to_device(child)) \
+            child = X.TpuCoalescedShuffleReaderExec(
+                TpuShuffleExchangeExec(part, _to_device(child))) \
                 if on_tpu else CpuShuffleExchangeExec(part, _to_host(child))
         if on_tpu:
             return X.TpuSortExec(orders, [o.child for o in orders],
@@ -407,10 +476,10 @@ class TpuOverrides:
                       on_tpu: bool) -> PhysicalOp:
         left, right = conv
         if node.how == "cross" or not node.left_keys:
-            if on_tpu and node.how in ("cross", "inner"):
+            if on_tpu:
                 return X.TpuNestedLoopJoinExec(
-                    _to_device(left), _to_device(right), node.condition,
-                    node.schema)
+                    _to_device(left), _to_device(right), node.how,
+                    node.condition, node.schema)
             return C.CpuNestedLoopJoinExec(
                 _to_host(left), _to_host(right), node.how, node.condition,
                 node.schema)
@@ -472,6 +541,46 @@ class _FakeNode:
     @property
     def schema(self):
         return self._schema
+
+
+def _split_conjuncts(e: Expression) -> List[Expression]:
+    from spark_rapids_tpu.exprs.predicates import And
+    if isinstance(e, And):
+        return _split_conjuncts(e.children[0]) + \
+            _split_conjuncts(e.children[1])
+    return [e]
+
+
+def _pushdown_scan_filters(plan: L.LogicalPlan) -> L.LogicalPlan:
+    """Push Filter conjuncts into a child FileScan so the parquet reader can
+    skip row groups on statistics and prune partition directories
+    (GpuParquetScan.scala:217-281 filterBlocks role).  Advisory: the Filter
+    stays in place for exact row filtering.
+
+    Non-mutating: untouched subtrees return the ORIGINAL nodes (the
+    user-held plan object never changes, and the session's fingerprint
+    cache — computed on the pre-rewrite tree — stays hittable)."""
+    import copy
+
+    from spark_rapids_tpu.io.scan import extract_pushdown_descriptors
+    new_children = [_pushdown_scan_filters(c) for c in plan.children]
+    changed = any(n is not o for n, o in zip(new_children, plan.children))
+    if isinstance(plan, L.Filter) and \
+            isinstance(new_children[0], L.FileScan):
+        scan = new_children[0]
+        conjuncts = _split_conjuncts(plan.condition)
+        pushable = [c for c in conjuncts
+                    if extract_pushdown_descriptors([c])]
+        if pushable:
+            new_scan = L.FileScan(scan.fmt, scan.paths, scan.schema,
+                                  scan.options, pushed_filters=pushable,
+                                  partitions=scan.partitions)
+            return L.Filter(plan.condition, new_scan)
+    if not changed:
+        return plan
+    clone = copy.copy(plan)
+    clone.children = tuple(new_children)
+    return clone
 
 
 def _compile_plan_udfs(plan: L.LogicalPlan) -> L.LogicalPlan:

@@ -48,7 +48,7 @@ def concat_static(batches: List[ColumnBatch], schema: T.Schema
     cap = round_up_capacity(sum(b.capacity for b in batches))
     byte_caps = []
     for i, f in enumerate(schema.fields):
-        if f.dtype.is_string:
+        if f.dtype.is_string or f.dtype.is_array:
             byte_caps.append(round_up_capacity(
                 sum(int(b.columns[i].data.shape[0]) for b in batches),
                 minimum=16))

@@ -1,0 +1,56 @@
+"""Python-worker admission for pandas execs.
+
+Reference analogue: PythonWorkerSemaphore (python/PythonWorkerSemaphore.scala
+:97) — the rapids plugin bounds how many python workers may run
+concurrently so python memory stays within
+``spark.rapids.python.concurrentPythonWorkers``.  Here python UDF code runs
+in-process (threads share the interpreter), so the semaphore bounds
+concurrent pandas-exec evaluations and, like the reference's GpuSemaphore
+interplay, the DEVICE semaphore is released while python runs so TPU slots
+are not held hostage by slow python.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+from spark_rapids_tpu.config import RapidsConf, conf_int
+
+CONCURRENT_PYTHON_WORKERS = conf_int(
+    "spark.rapids.python.concurrentPythonWorkers", 4,
+    "Concurrent python (pandas UDF / pandas exec) evaluations allowed "
+    "per process (PythonWorkerSemaphore analogue).")
+
+_lock = threading.Lock()
+_sem: Optional[threading.Semaphore] = None
+_sem_permits = 0
+
+
+def _semaphore(conf: RapidsConf) -> threading.Semaphore:
+    global _sem, _sem_permits
+    with _lock:
+        permits = max(1, CONCURRENT_PYTHON_WORKERS.get(conf))
+        if _sem is None or permits != _sem_permits:
+            _sem = threading.Semaphore(permits)
+            _sem_permits = permits
+        return _sem
+
+
+@contextlib.contextmanager
+def python_worker_slot(ctx):
+    """Bound python concurrency; release the device semaphore while python
+    runs (the GpuSemaphore release in GpuArrowEvalPythonExec.scala:484)."""
+    sem = _semaphore(ctx.conf)
+    released_device = False
+    if ctx.semaphore is not None:
+        ctx.semaphore.release()
+        released_device = True
+    sem.acquire()
+    try:
+        yield
+    finally:
+        sem.release()
+        if released_device:
+            ctx.semaphore.acquire()

@@ -94,13 +94,19 @@ class TpuSparkSession:
     # -- execution ----------------------------------------------------------
 
     def plan_physical(self, plan):
-        """Lower a logical plan, memoized per (plan identity, conf state)."""
+        """Lower a logical plan, memoized per (canonical plan fingerprint,
+        conf state) — the canonicalized-plan-reuse role
+        (GpuOverrides + Spark plan canonicalization): two structurally
+        identical DataFrames (e.g. ``df.count()`` called twice, each
+        building a fresh Aggregate node) share one physical plan and
+        therefore every compiled XLA kernel."""
+        from spark_rapids_tpu.plan.logical import plan_fingerprint
         from spark_rapids_tpu.plan.overrides import TpuOverrides
-        key = id(plan)
+        key = plan_fingerprint(plan)
         conf_state = tuple(sorted(
             (k, str(v)) for k, v in self.conf._settings.items()))
         hit = self._plan_cache.get(key)
-        if hit is not None and hit[0] is plan and hit[1] == conf_state:
+        if hit is not None and hit[1] == conf_state:
             self.last_explain = hit[3]
             return hit[2]
         overrides = TpuOverrides(self.conf)
@@ -120,8 +126,8 @@ class TpuSparkSession:
         RapidsShuffleManager in docs/get-started).  On a single-chip
         process this is always None and exchanges use the host path.
         """
-        if self.conf.get("spark.rapids.shuffle.ici.enabled", False) \
-                in (False, "false", None):
+        from spark_rapids_tpu.config import ENABLE_ICI_SHUFFLE
+        if not ENABLE_ICI_SHUFFLE.get(self.conf):
             return None
         if not hasattr(self, "_mesh"):
             import jax
@@ -145,6 +151,8 @@ class TpuSparkSession:
         self.last_metrics = {
             op: {name: m.value for name, m in ms.items()}
             for op, ms in ctx.metrics.items()}
+        if self.runtime is not None:
+            self.last_metrics["memory"] = dict(self.runtime.catalog.metrics)
         return out
 
     def explain_plan(self, plan) -> str:
@@ -185,14 +193,26 @@ class DataFrameReader:
 
     def _scan(self, fmt: str, paths: Union[str, Sequence[str]]):
         from spark_rapids_tpu.dataframe import DataFrame
-        from spark_rapids_tpu.io.discovery import expand_paths, infer_schema
+        from spark_rapids_tpu.io.discovery import (
+            discover_partitions, expand_paths, infer_schema,
+        )
         from spark_rapids_tpu.plan.logical import FileScan
         if isinstance(paths, str):
             paths = [paths]
         files = expand_paths(list(paths), fmt)
         schema = self._schema or infer_schema(fmt, files, self._options)
+        partitions = discover_partitions(list(paths), files)
+        if partitions is not None:
+            part_schema, _vals = partitions
+            new_fields = [f for f in part_schema.fields
+                          if f.name not in set(schema.names)]
+            if new_fields:
+                schema = T.Schema(list(schema.fields) + new_fields)
+            else:
+                partitions = None
         return DataFrame(
-            FileScan(fmt, files, schema, dict(self._options)), self.session)
+            FileScan(fmt, files, schema, dict(self._options),
+                     partitions=partitions), self.session)
 
     def parquet(self, *paths: str):
         return self._scan("parquet", list(paths))
@@ -241,6 +261,10 @@ def _infer_dtype(values) -> T.DataType:
             return T.DOUBLE
         if isinstance(v, str):
             return T.STRING
+        if isinstance(v, (list, tuple)):
+            elems = [e for arr in values if arr is not None
+                     for e in arr if e is not None]
+            return T.ArrayType(_infer_dtype(elems) if elems else T.LONG)
     return T.STRING
 
 

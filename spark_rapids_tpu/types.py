@@ -68,6 +68,10 @@ class DataType:
     def is_datetime(self) -> bool:
         return isinstance(self, (DateType, TimestampType))
 
+    @property
+    def is_array(self) -> bool:
+        return False
+
 
 class NumericType(DataType):
     pass
@@ -142,6 +146,41 @@ class NullType(DataType):
 
     jnp_dtype = jnp.int32
     np_dtype = np.int32
+
+
+class ArrayType(DataType):
+    """array<element>: the start of the nested-type envelope
+    (reference gates most nested types too — GpuOverrides.scala:397-409).
+
+    Device layout mirrors strings (which are array<byte>): flat element
+    buffer + offsets int32[n+1] + row validity.  v1 restrictions: elements
+    are fixed-width (no array<string>/array<array>) and element-level
+    NULLs are not represented (the reference's early versions gated the
+    same).  Host oracle keeps python lists / None.
+    """
+
+    np_dtype = np.object_
+
+    def __init__(self, element: DataType):
+        assert element.jnp_dtype is not None and not element.is_string and \
+            not isinstance(element, ArrayType), \
+            f"unsupported array element type: {element}"
+        self.element = element
+        self.jnp_dtype = element.jnp_dtype
+
+    @property
+    def name(self) -> str:
+        return f"array<{self.element.name}>"
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, ArrayType) and self.element == other.element
+
+    def __hash__(self) -> int:
+        return hash((ArrayType, self.element))
+
+    @property
+    def is_array(self) -> bool:
+        return True
 
 
 # Singletons, Spark-style.

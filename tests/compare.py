@@ -7,6 +7,8 @@ import pytest
 from spark_rapids_tpu.config import RapidsConf
 from spark_rapids_tpu.session import TpuSparkSession
 
+from conftest import FLOAT_ABS, FLOAT_REL, TEST_PLATFORM
+
 
 def cpu_session(**confs) -> TpuSparkSession:
     conf = RapidsConf({"spark.rapids.sql.enabled": False,
@@ -25,6 +27,8 @@ def tpu_session(**confs) -> TpuSparkSession:
 
 
 def _canon(rows, approx, ignore_order):
+    approx = approx or TEST_PLATFORM == "tpu"
+
     def enc(v):
         if v is None:
             return (0, "")
@@ -32,7 +36,8 @@ def _canon(rows, approx, ignore_order):
             if v != v:
                 return (1, "NaN")
             if approx:
-                return (1, round(v, 6))
+                # platform=tpu: f64 emulation -> fewer trustworthy digits
+                return (1, round(v, 3 if TEST_PLATFORM == "tpu" else 6))
             return (1, v)
         if isinstance(v, bool):
             return (2, v)
@@ -66,7 +71,7 @@ def assert_tpu_cpu_equal(build_fn, approx=False, ignore_order=True,
     assert len(a) == len(b), \
         f"row count: cpu={len(a)} tpu={len(b)}\ncpu={a[:10]}\ntpu={b[:10]}"
     for i, (ra, rb) in enumerate(zip(a, b)):
-        if approx:
+        if approx or TEST_PLATFORM == "tpu":
             _row_approx_eq(ra, rb, i)
         else:
             assert ra == rb, f"row {i}: cpu={ra} tpu={rb}"
@@ -77,6 +82,7 @@ def _row_approx_eq(ra, rb, i):
     for (ta, va), (tb, vb) in zip(ra, rb):
         assert ta == tb, f"row {i}: {va!r} vs {vb!r}"
         if isinstance(va, float) and isinstance(vb, float):
-            assert vb == pytest.approx(va, rel=1e-5, abs=1e-8), f"row {i}"
+            assert vb == pytest.approx(va, rel=max(FLOAT_REL, 1e-5),
+                                       abs=max(FLOAT_ABS, 1e-8)), f"row {i}"
         else:
             assert va == vb, f"row {i}: {va!r} vs {vb!r}"

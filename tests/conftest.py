@@ -1,26 +1,44 @@
 """Test harness: run everything on a virtual 8-device CPU mesh so multi-chip
-sharding logic is exercised without TPU hardware (SURVEY.md environment notes).
+sharding logic is exercised without TPU hardware (SURVEY.md environment
+notes).
+
+Real-chip mode: ``SPARK_RAPIDS_TEST_PLATFORM=tpu`` skips the CPU forcing so
+the same compare suites execute against the actual TPU backend (the CPU
+oracle side of each compare still runs in numpy).  Double-precision results
+then go through XLA's f64 emulation (~48-bit mantissa — see
+docs/compatibility.md "Double precision on TPU"), so float comparisons are
+relaxed to the tolerances below.
 
 Must configure XLA before jax initializes its backends.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+TEST_PLATFORM = os.environ.get("SPARK_RAPIDS_TEST_PLATFORM", "cpu")
+
+if TEST_PLATFORM != "tpu":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax
 import numpy as np
 import pytest
 
-# The environment's sitecustomize pins JAX_PLATFORMS to the TPU plugin; the
-# config update (post-import, pre-backend-init) reliably forces CPU for tests.
-jax.config.update("jax_platforms", "cpu")
+if TEST_PLATFORM != "tpu":
+    # The environment's sitecustomize pins JAX_PLATFORMS to the TPU plugin;
+    # the config update (post-import, pre-backend-init) reliably forces CPU
+    # for tests.
+    jax.config.update("jax_platforms", "cpu")
 
 import spark_rapids_tpu  # noqa: F401  (enables x64)
+
+# f64 emulation on TPU carries ~48 mantissa bits; aggregations also reorder
+# float reductions.  CPU mode keeps tight tolerances.
+FLOAT_REL = 1e-4 if TEST_PLATFORM == "tpu" else 1e-6
+FLOAT_ABS = 1e-6 if TEST_PLATFORM == "tpu" else 1e-9
 
 
 @pytest.fixture
@@ -32,6 +50,7 @@ def assert_cols_equal(expected, actual, approx=False, msg=""):
     """Deep-compare two column value lists (None = NULL)."""
     assert len(expected) == len(actual), \
         f"{msg}: row count {len(expected)} != {len(actual)}"
+    approx = approx or TEST_PLATFORM == "tpu"
     for i, (e, a) in enumerate(zip(expected, actual)):
         if e is None or a is None:
             assert e is None and a is None, f"{msg} row {i}: {e!r} != {a!r}"
@@ -39,7 +58,7 @@ def assert_cols_equal(expected, actual, approx=False, msg=""):
             if e != e:  # NaN
                 assert a != a, f"{msg} row {i}: {e!r} != {a!r}"
             else:
-                assert a == pytest.approx(e, rel=1e-6, abs=1e-9), \
+                assert a == pytest.approx(e, rel=FLOAT_REL, abs=FLOAT_ABS), \
                     f"{msg} row {i}: {e!r} != {a!r}"
         else:
             assert e == a, f"{msg} row {i}: {e!r} != {a!r}"
@@ -48,6 +67,7 @@ def assert_cols_equal(expected, actual, approx=False, msg=""):
 def assert_batches_equal(expected, actual, approx=False, ignore_order=False):
     """Compare two HostBatch-like pydicts."""
     e, a = expected, actual
+    approx = approx or TEST_PLATFORM == "tpu"
     assert set(e.keys()) == set(a.keys()), f"{e.keys()} != {a.keys()}"
     if ignore_order:
         def keyed(d):
@@ -65,13 +85,15 @@ def assert_batches_equal(expected, actual, approx=False, ignore_order=False):
                     if x != x:
                         assert y != y
                     else:
-                        assert y == pytest.approx(x, rel=1e-6, abs=1e-9), \
+                        assert y == pytest.approx(
+                            x, rel=FLOAT_REL, abs=FLOAT_ABS), \
                             f"row {i} col {c}: {x!r} != {y!r}"
                 else:
                     assert (x is None) == (y is None) and (
                         x is None or x == y or
                         (approx and isinstance(x, float)
-                         and y == pytest.approx(x, rel=1e-6, abs=1e-9))), \
+                         and y == pytest.approx(
+                             x, rel=FLOAT_REL, abs=FLOAT_ABS))), \
                         f"row {i} col {c}: {x!r} != {y!r}"
     else:
         for name in e:

@@ -1,0 +1,91 @@
+"""Array-type + explode tests (GpuGenerateExec / nested-type envelope v1)."""
+
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu import types as T
+
+from compare import assert_tpu_cpu_equal, tpu_session
+
+ARR = T.ArrayType(T.LONG)
+DATA = {
+    "k": (T.STRING, ["a", "b", "c", "d", "e"]),
+    "arr": (ARR, [[1, 2, 3], [], [4], None, [5, 6]]),
+    "v": (T.LONG, [10, 20, 30, 40, 50]),
+}
+
+
+def test_array_roundtrip():
+    s = tpu_session()
+    df = s.create_dataframe(DATA, num_partitions=2)
+    rows = df.select("k", "arr").collect()
+    got = dict(rows)
+    assert got["a"] == [1, 2, 3] and got["b"] == [] and got["d"] is None
+
+
+def test_explode_on_tpu():
+    s = tpu_session()
+    df = s.create_dataframe(DATA, num_partitions=2)
+    out = df.explode("arr", alias="e")
+    rows = sorted(out.collect())
+    assert rows == sorted([
+        ("a", 10, 1), ("a", 10, 2), ("a", 10, 3), ("c", 30, 4),
+        ("e", 50, 5), ("e", 50, 6)])
+    assert "TpuGenerate" in s.last_physical_plan.tree_string()
+
+
+def test_explode_vs_cpu_oracle():
+    assert_tpu_cpu_equal(
+        lambda s: s.create_dataframe(DATA, num_partitions=3)
+        .explode("arr", alias="e").filter(F.col("e") > 1))
+
+
+def test_posexplode():
+    assert_tpu_cpu_equal(
+        lambda s: s.create_dataframe(DATA).explode(
+            "arr", alias="e", pos=True))
+
+
+def test_explode_outer_falls_back():
+    def q(s):
+        return s.create_dataframe(DATA).explode("arr", alias="e",
+                                                outer=True)
+    assert_tpu_cpu_equal(q, expect_fallback="Generate")
+    s = tpu_session()
+    rows = q(s).collect()
+    # 'b' (empty) and 'd' (NULL array) each keep one NULL-element row
+    assert ("b", 20, None) in rows and ("d", 40, None) in rows
+
+
+def test_create_array_and_explode():
+    def q(s):
+        df = s.create_dataframe({"x": (T.LONG, [1, 2]),
+                                 "y": (T.LONG, [10, 20])})
+        return df.with_column("a", F.array("x", "y")).explode("a", "e")
+    assert_tpu_cpu_equal(q)
+
+
+def test_infer_list_dtype():
+    s = tpu_session()
+    df = s.create_dataframe({"a": [[1, 2], [3]], "n": [1, 2]})
+    assert df.schema.field("a").dtype == T.ArrayType(T.LONG)
+    assert sorted(df.explode("a", "e").collect()) == \
+        [(1, 1), (1, 2), (2, 3)]
+
+
+def test_groupby_on_exploded():
+    """Explode feeding a TPU aggregation (arrays gone from the schema by
+    then, so the agg stays on device)."""
+    def q(s):
+        df = s.create_dataframe(DATA, num_partitions=2)
+        return df.explode("arr", "e").group_by("k").agg(
+            F.sum(F.col("e")).alias("s"), F.count(F.col("e")).alias("c"))
+    assert_tpu_cpu_equal(q)
+
+
+def test_array_column_blocks_tpu_sort():
+    s = tpu_session()
+    df = s.create_dataframe(DATA)
+    df.order_by("k").collect()
+    assert "cannot run on TPU" in s.last_explain \
+        and "array columns" in s.last_explain

@@ -257,3 +257,129 @@ def test_large_batch_shrink_path():
                  .group_by("k", "s").agg(F.sum("v").alias("sv"),
                                          F.count("v").alias("cv"))
     assert_tpu_cpu_equal(q)
+
+
+@pytest.mark.parametrize("bc", ["broadcast", "shuffle"])
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full",
+                                 "left_semi", "left_anti"])
+def test_join_types_with_residual_condition(how, bc):
+    """Residual conditions gate matches INSIDE the join for every type
+    (GpuHashJoin.scala:265-271): a row whose matches all fail the
+    condition must come out null-padded / kept / dropped per the type."""
+    other = {
+        "k": (T.INT, [2, 3, 5, 5, 8, None]),
+        "w": (T.LONG, [15, 100, 55, 9, 70, 1]),
+        "v": (T.STRING, ["x", "y", "z", "w", "q", "n"]),
+    }
+
+    def q(s):
+        df = make_df(s)
+        d2 = s.create_dataframe(other, num_partitions=2)
+        return df.join(d2, on=(df["a"] == d2["k"]) & (df["b"] < d2["w"]),
+                       how=how)
+    confs = {} if bc == "broadcast" else \
+        {"spark.sql.autoBroadcastJoinThreshold": -1}
+    assert_tpu_cpu_equal(q, confs=confs)
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full",
+                                 "left_semi", "left_anti"])
+def test_nested_loop_join_types(how):
+    """Non-equi-only conditions plan as a nested-loop join; all types run
+    on TPU (GpuBroadcastNestedLoopJoinExec.scala:305 parity)."""
+    other = {
+        "k": (T.INT, [1, 3, 6, None]),
+        "v": (T.STRING, ["p", "q", "r", "s"]),
+    }
+
+    def q(s):
+        df = make_df(s).select("a", "s")
+        d2 = s.create_dataframe(other)
+        return df.join(d2, on=df["a"] < d2["k"], how=how)
+    assert_tpu_cpu_equal(q)
+
+
+def test_nested_loop_join_runs_on_tpu():
+    s = tpu_session()
+    df = make_df(s).select("a")
+    d2 = s.create_dataframe({"k": (T.INT, [1, 3])})
+    out = df.join(d2, on=df["a"] < d2["k"], how="left")
+    out.collect()
+    assert "TpuNestedLoopJoin(left)" in s.last_physical_plan.tree_string()
+
+
+# ---------------------------------------------------------------------------
+# Non-collapsed exchange matrix: collapseLocal=false exercises the device
+# partition-split path (exchange.py device split + spillable outputs) that
+# the mesh path builds on.
+# ---------------------------------------------------------------------------
+
+NO_COLLAPSE = {"spark.rapids.sql.tpu.exchange.collapseLocal": False}
+
+
+@pytest.mark.parametrize("case", ["groupby", "groupby_str", "sort", "join",
+                                  "window_less", "limit", "distinct"])
+def test_non_collapsed_exchange_matrix(case):
+    def q(s):
+        df = make_df(s)
+        if case == "groupby":
+            return df.group_by("a").agg(
+                Column(Alias(Sum(ColumnRef("b")), "sum_b")),
+                Column(Alias(Count(ColumnRef("b")), "cnt")))
+        if case == "groupby_str":
+            return df.group_by("s").agg(
+                Column(Alias(Sum(ColumnRef("a")), "sum_a")))
+        if case == "sort":
+            return df.order_by(df["a"].desc(), df["s"].asc())
+        if case == "join":
+            d2 = s.create_dataframe({
+                "a": (T.INT, [2, 3, 5, None]),
+                "w": (T.LONG, [1, 2, 3, 4])}, num_partitions=2)
+            return df.join(d2, on="a", how="left")
+        if case == "window_less":
+            return df.select("a", "b").distinct()
+        if case == "limit":
+            return df.order_by("b").limit(4)
+        return df.select("s").distinct()
+
+    confs = dict(NO_COLLAPSE)
+    if case == "join":
+        confs["spark.sql.autoBroadcastJoinThreshold"] = -1
+    assert_tpu_cpu_equal(q, confs=confs,
+                         ignore_order=case not in ("sort", "limit"))
+
+
+def test_metrics_surfaced():
+    """session.last_metrics reports pipeline program counts, op metrics and
+    catalog spill counters (GpuExec.scala:27-56 metric surface role)."""
+    s = tpu_session()
+    df = make_df(s)
+    df.group_by("a").agg(Column(Alias(Sum(ColumnRef("b")), "x"))).collect()
+    m = s.last_metrics
+    assert m.get("pipeline", {}).get("programs", 0) >= 1, m
+    assert "memory" in m and "spilled_to_host" in m["memory"], m
+    # iterator path (pipeline off) surfaces per-op collect metrics
+    s2 = tpu_session(**{"spark.rapids.sql.tpu.pipeline.enabled": False})
+    df2 = make_df(s2)
+    df2.group_by("a").agg(
+        Column(Alias(Sum(ColumnRef("b")), "x"))).collect()
+    m2 = s2.last_metrics
+    assert m2.get("collect", {}).get("batches", 0) >= 1, m2
+
+
+def test_canonical_plan_reuse():
+    """Structurally identical plans (rebuilt DataFrames, repeated count())
+    share one physical plan and its compiled kernels — the plan
+    canonicalization / reuse role."""
+    s = tpu_session()
+    df = make_df(s)
+    g1 = df.group_by("a").sum("b")
+    g2 = df.group_by("a").sum("b")
+    assert s.plan_physical(g1.plan) is s.plan_physical(g2.plan)
+    # different conf state -> different physical plan
+    s.conf.set("spark.rapids.sql.exec.Aggregate", False)
+    assert s.plan_physical(g1.plan) is not None
+    s.conf.set("spark.rapids.sql.exec.Aggregate", True)
+    # different plan shape -> miss
+    g3 = df.group_by("a").sum("b").filter(Column(ColumnRef("a")) > 1)
+    assert s.plan_physical(g3.plan) is not s.plan_physical(g1.plan)

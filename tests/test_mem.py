@@ -84,3 +84,42 @@ def test_dataframe_cache():
     assert cached.plan.holder.is_materialized
     cached.unpersist()
     assert not cached.plan.holder.is_materialized
+
+
+def test_join_spills_under_tiny_budget():
+    """A shuffled join whose shuffle outputs exceed the device budget must
+    spill shuffle pieces to host mid-query and still produce correct
+    results (RapidsShuffleInternalManager.scala:91-154 +
+    SpillableColumnarBatch.scala:27 role)."""
+    import numpy as np
+    from spark_rapids_tpu.runtime.device import DeviceRuntime
+    from spark_rapids_tpu.session import TpuSparkSession
+    from spark_rapids_tpu.config import RapidsConf
+
+    DeviceRuntime.reset()
+    try:
+        conf = RapidsConf({
+            "spark.rapids.sql.enabled": True,
+            "spark.sql.shuffle.partitions": 4,
+            "spark.rapids.sql.tpu.exchange.collapseLocal": False,
+            "spark.sql.autoBroadcastJoinThreshold": -1,
+            # ~64KB device budget: far below the shuffle working set
+            "spark.rapids.memory.tpu.spillBudgetBytes": 64 * 1024,
+        })
+        s = TpuSparkSession(conf)
+        n = 20_000
+        rng = np.random.RandomState(5)
+        left = s.create_dataframe(
+            {"k": rng.randint(0, 500, n).tolist(),
+             "v": rng.randint(0, 100, n).tolist()}, num_partitions=3)
+        right = s.create_dataframe(
+            {"k": list(range(500)), "w": list(range(500))},
+            num_partitions=2)
+        out = left.join(right, on="k", how="inner")
+        rows = out.collect()
+        assert len(rows) == n  # every left row matches exactly one right row
+        mem = s.last_metrics.get("memory", {})
+        assert mem.get("spilled_to_host", 0) > 0, mem
+        assert mem.get("unspilled", 0) > 0, mem
+    finally:
+        DeviceRuntime.reset()

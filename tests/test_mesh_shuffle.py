@@ -143,3 +143,14 @@ def test_mesh_strings_survive_roundtrip():
     exp = sorted(expect.items(), key=lambda r: (r[0] is None, str(r[0])))
     assert [(a, b) for a, b in rows] == exp
     _assert_mesh_used(sess)
+
+
+def test_multihost_single_process_noop():
+    """World size 1 (every dev/test environment): init is a no-op and the
+    process-group info reflects a single process."""
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.parallel.multihost import init_multihost, world_info
+    assert init_multihost(RapidsConf()) is False
+    info = world_info()
+    assert info["process_count"] == 1 and info["process_index"] == 0
+    assert info["global_devices"] == info["local_devices"]

@@ -1,0 +1,131 @@
+"""Pandas-exec family tests (GpuMapInPandasExec /
+GpuFlatMapGroupsInPandasExec / GpuFlatMapCoGroupsInPandasExec /
+GpuAggregateInPandasExec analogues)."""
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+
+from compare import tpu_session
+
+DATA = {
+    "k": (T.STRING, ["a", "b", "a", "c", "b", "a", None, "c"]),
+    "v": (T.LONG, [1, 2, 3, 4, 5, 6, 7, 8]),
+    "x": (T.DOUBLE, [0.5, 1.0, 1.5, 2.0, 2.5, 3.0, 3.5, 4.0]),
+}
+
+
+def test_map_in_pandas():
+    s = tpu_session()
+    df = s.create_dataframe(DATA, num_partitions=3)
+
+    def fn(it):
+        for pdf in it:
+            pdf = pdf.copy()
+            pdf["v2"] = pdf["v"] * 2
+            yield pdf[["k", "v2"]]
+
+    out = df.map_in_pandas(fn, [("k", T.STRING), ("v2", T.LONG)])
+    rows = sorted(out.collect(), key=lambda r: (r[0] is None, str(r)))
+    expect = sorted(
+        [(k, v * 2) for k, v in zip(DATA["k"][1], DATA["v"][1])],
+        key=lambda r: (r[0] is None, str(r)))
+    assert rows == expect
+
+
+def test_apply_in_pandas_grouped_map():
+    s = tpu_session()
+    df = s.create_dataframe(DATA, num_partitions=3)
+
+    def center(pdf):
+        pdf = pdf.copy()
+        pdf["v"] = pdf["v"] - pdf["v"].mean()
+        return pdf[["k", "v"]]
+
+    out = df.group_by("k").apply_in_pandas(
+        center, [("k", T.STRING), ("v", T.DOUBLE)])
+    rows = out.collect()
+    # group a: v = 1,3,6 -> mean 10/3; group b: 2,5 -> 3.5; c: 4,8 -> 6
+    by_key = {}
+    for k, v in rows:
+        by_key.setdefault(k, []).append(round(v, 6))
+    assert sorted(by_key["b"]) == [-1.5, 1.5]
+    assert sorted(by_key["c"]) == [-2.0, 2.0]
+    assert len(by_key["a"]) == 3
+    assert abs(sum(by_key["a"])) < 1e-5  # rounded to 6 dp above
+
+
+def test_agg_in_pandas():
+    s = tpu_session()
+    df = s.create_dataframe(DATA, num_partitions=2)
+    out = df.group_by("k").agg_in_pandas({
+        "v_sum": (lambda ser: ser.sum(), T.LONG, "v"),
+        "x_max": (lambda ser: ser.max(), T.DOUBLE, "x"),
+    })
+    rows = {r[0]: (r[1], r[2]) for r in out.collect()}
+    assert rows["a"] == (10, 3.0)
+    assert rows["b"] == (7, 2.5)
+    assert rows["c"] == (12, 4.0)
+
+
+def test_cogroup_apply_in_pandas():
+    s = tpu_session()
+    left = s.create_dataframe({
+        "k": (T.STRING, ["a", "b", "a"]),
+        "v": (T.LONG, [1, 2, 3])})
+    right = s.create_dataframe({
+        "k": (T.STRING, ["a", "c"]),
+        "w": (T.LONG, [10, 30])})
+
+    def fn(lg, rg):
+        import pandas as pd
+        key = lg["k"].iloc[0] if len(lg) else rg["k"].iloc[0]
+        return pd.DataFrame({
+            "k": [key],
+            "l_sum": [int(lg["v"].sum()) if len(lg) else 0],
+            "r_sum": [int(rg["w"].sum()) if len(rg) else 0],
+        })
+
+    out = left.group_by("k").cogroup(right.group_by("k")).apply_in_pandas(
+        fn, [("k", T.STRING), ("l_sum", T.LONG), ("r_sum", T.LONG)])
+    rows = {r[0]: (r[1], r[2]) for r in out.collect()}
+    assert rows == {"a": (4, 10), "b": (2, 0), "c": (0, 30)}
+
+
+def test_pandas_exec_explains_fallback():
+    s = tpu_session()
+    df = s.create_dataframe(DATA)
+    out = df.map_in_pandas(lambda it: it, [("k", T.STRING), ("v", T.LONG),
+                                           ("x", T.DOUBLE)])
+    out.collect()
+    assert "host Arrow path" in s.last_explain
+
+
+def test_worker_semaphore_bounds_concurrency():
+    import threading
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.plan.physical import ExecContext
+    from spark_rapids_tpu.runtime import python_worker as pw
+
+    conf = RapidsConf({"spark.rapids.python.concurrentPythonWorkers": 2})
+    ctx = ExecContext(conf)
+    active = []
+    peak = []
+    lock = threading.Lock()
+
+    def work():
+        with pw.python_worker_slot(ctx):
+            with lock:
+                active.append(1)
+                peak.append(len(active))
+            import time
+            time.sleep(0.05)
+            with lock:
+                active.pop()
+
+    threads = [threading.Thread(target=work) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert max(peak) <= 2

@@ -1,0 +1,30 @@
+"""TPC-DS-like query correctness at SF0.1: every query runs on the TPU
+engine and the CPU engine and must agree (TpcdsLikeSpark suite analogue)."""
+
+import pytest
+
+from spark_rapids_tpu.benchmarks.tpcds_like import QUERIES, register_tpcds
+
+from compare import assert_tpu_cpu_equal
+
+SF = 0.1
+
+
+@pytest.mark.parametrize("qname", sorted(QUERIES.keys()))
+def test_tpcds_like_query(qname):
+    def build(s):
+        register_tpcds(s, sf=SF, num_partitions=3)
+        return s.sql(QUERIES[qname])
+
+    assert_tpu_cpu_equal(build, approx=True, ignore_order=False)
+
+
+def test_tpcds_bench_report(tmp_path):
+    from compare import tpu_session
+    from spark_rapids_tpu.benchmarks.bench_utils import run_bench
+    s = tpu_session()
+    register_tpcds(s, sf=0.05, num_partitions=2)
+    path = str(tmp_path / "tpcds_report.json")
+    rep = run_bench(s, "q55", lambda: s.sql(QUERIES["q55"]),
+                    iterations=1, warmups=0, report_path=path)
+    assert rep["result_rows"] >= 1
