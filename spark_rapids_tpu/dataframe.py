@@ -131,6 +131,10 @@ class Column:
     def desc(self, nulls_first: Optional[bool] = None) -> SortOrder:
         return SortOrder(self.expr, False, nulls_first)
 
+    def getItem(self, ordinal: int) -> "Column":
+        from spark_rapids_tpu.exprs.misc import GetArrayItem
+        return Column(GetArrayItem(self.expr, ordinal))
+
     def substr(self, start: int, length: int):
         from spark_rapids_tpu.exprs.strings import Substring
         return Column(Substring(self.expr, start, length))

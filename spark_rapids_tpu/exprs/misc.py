@@ -148,3 +148,89 @@ class CreateArray(Expression):
                  T.np_scalar(elem, v.values[i]))
                 for v in vals]
         return CpuVal(self.dtype, out, np.ones(n, dtype=np.bool_))
+
+
+class GetArrayItem(Expression):
+    """arr[i] with a literal 0-based ordinal (GpuGetArrayItem,
+    complexTypeExtractors.scala): NULL when out of range or the array row
+    is NULL."""
+
+    def __init__(self, child: Expression, ordinal: int):
+        self.children = (child,)
+        self.ordinal = int(ordinal)
+        # pre-resolution the child is an untyped ColumnRef; the planner
+        # rebuilds this node with resolved children (with_children)
+        self.dtype = child.dtype.element \
+            if isinstance(child.dtype, T.ArrayType) else T.NULL
+        self.nullable = True
+
+    def with_children(self, children):
+        return GetArrayItem(children[0], self.ordinal)
+
+    def tpu_supported(self, conf):
+        if not isinstance(self.children[0].dtype, T.ArrayType):
+            return f"getItem needs an array, got {self.children[0].dtype}"
+        return None
+
+    def tpu_eval(self, ctx) -> DevVal:
+        import jax.numpy as jnp
+        v = self.children[0].tpu_eval(ctx)
+        if self.ordinal < 0:
+            # Spark: negative ordinals are out of range -> NULL
+            return DevVal(self.dtype,
+                          jnp.zeros(ctx.capacity,
+                                    dtype=self.dtype.jnp_dtype),
+                          jnp.zeros(ctx.capacity, dtype=jnp.bool_))
+        lens = (v.offsets[1:] - v.offsets[:-1]).astype(jnp.int32)
+        in_range = self.ordinal < lens
+        idx = jnp.clip(v.offsets[:-1] + self.ordinal, 0,
+                       int(v.data.shape[0]) - 1)
+        data = jnp.where(in_range, v.data[idx], 0)
+        return DevVal(self.dtype, data.astype(self.dtype.jnp_dtype),
+                      v.validity & in_range & ctx.row_mask)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        # Spark semantics: negative / out-of-range ordinals yield NULL
+        # (non-ANSI), never python-style tail indexing.
+        v = self.children[0].cpu_eval(ctx)
+        n = len(v.values)
+        out = np.zeros(n, dtype=self.dtype.np_dtype)
+        ok = np.zeros(n, dtype=np.bool_)
+        k = self.ordinal
+        for i, (arr, valid) in enumerate(zip(v.values, v.validity)):
+            if valid and arr is not None and 0 <= k < len(arr) and \
+                    arr[k] is not None:
+                out[i] = arr[k]
+                ok[i] = True
+        return CpuVal(self.dtype, out, ok)
+
+
+class ArraySize(UnaryExpression):
+    """size(arr) -> INT element count; size(NULL) -> NULL.
+
+    This matches Spark with ``spark.sql.legacy.sizeOfNull=false`` (the
+    ANSI-aligned behavior; Spark's historical default returns -1 for NULL
+    input).  Documented divergence from the legacy default."""
+
+    def _resolve_type(self):
+        self.dtype = T.INT
+        self.nullable = self.child.nullable
+
+    def tpu_supported(self, conf):
+        if not isinstance(self.child.dtype, T.ArrayType):
+            return f"size needs an array, got {self.child.dtype}"
+        return None
+
+    def tpu_eval(self, ctx) -> DevVal:
+        import jax.numpy as jnp
+        v = self.child.tpu_eval(ctx)
+        lens = (v.offsets[1:] - v.offsets[:-1]).astype(jnp.int32)
+        return DevVal(T.INT, lens, v.validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        v = self.child.cpu_eval(ctx)
+        n = len(v.values)
+        out = np.zeros(n, dtype=np.int32)
+        for i, (arr, ok) in enumerate(zip(v.values, v.validity)):
+            out[i] = len(arr) if ok and arr is not None else 0
+        return CpuVal(T.INT, out, v.validity)

@@ -286,6 +286,17 @@ def hash(*cols) -> Column:  # noqa: A001
         col(c) if isinstance(c, str) else c) for c in cols]))
 
 
+def get_item(c, ordinal: int) -> Column:
+    from spark_rapids_tpu.exprs.misc import GetArrayItem
+    e = _to_expr(col(c) if isinstance(c, str) else c)
+    return Column(GetArrayItem(e, ordinal))
+
+
+def size(c) -> Column:
+    from spark_rapids_tpu.exprs.misc import ArraySize
+    return _unary(ArraySize, c)
+
+
 def array(*cols) -> Column:
     from spark_rapids_tpu.exprs.misc import CreateArray
     return Column(CreateArray(*[_to_expr(

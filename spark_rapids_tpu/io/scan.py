@@ -130,15 +130,67 @@ def _read_parquet_file(path: str, columns: List[str], batch_rows: int,
     return out
 
 
-def _read_orc_file(path: str, columns: List[str], batch_rows: int
+def _read_orc_file(path: str, columns: List[str], batch_rows: int,
+                   descriptors=None,
+                   counters: Optional[Dict[str, int]] = None
                    ) -> List[HostBatch]:
+    """ORC scan with stripe-level predicate skipping.
+
+    pyarrow exposes per-stripe reads but not the file's stripe statistics,
+    so the pushdown is two-pass (the OrcFilters/SearchArgument role,
+    OrcFilters.scala): pass 1 decodes ONLY the predicate columns of each
+    stripe and computes min/max/null-count on host; stripes that provably
+    cannot match skip the full decode of pass 2.  For selective predicates
+    over wide tables that removes most of the decode work.
+    """
     import pyarrow.orc as orc
     f = orc.ORCFile(path)
-    tb = f.read(columns=columns or None)
-    hb = arrow_to_host_batch(tb)
-    return [hb.slice(i, min(batch_rows, hb.num_rows - i))
-            for i in range(0, max(hb.num_rows, 1), batch_rows)] \
-        if hb.num_rows else []
+    n_stripes = f.nstripes
+    pred_cols = sorted({name for name, _op, _v in (descriptors or [])
+                        if name in (columns or [])})
+    keep: List[int] = []
+    for i in range(n_stripes):
+        if not descriptors or not pred_cols:
+            keep.append(i)
+            continue
+        probe = f.read_stripe(i, columns=pred_cols)
+        ok = True
+        for name, op, value in descriptors:
+            if name not in pred_cols:
+                continue
+            arr = probe.column(name)
+            nulls = arr.null_count
+            if op == "notnull":
+                if nulls == len(arr):
+                    ok = False
+                    break
+                continue
+            if nulls == len(arr):
+                ok = False  # all NULL: no comparison can hold
+                break
+            vals = arr.drop_null().to_numpy(zero_copy_only=False)
+            if vals.dtype.kind == "f" and np.isnan(vals).any():
+                # Spark orders NaN greater than everything (so NaN rows CAN
+                # match > / >= / = NaN predicates) and plain min/max would
+                # propagate NaN into the bounds — never skip such stripes
+                # (parquet writers likewise omit stats when NaN is present)
+                continue
+            if not _range_can_match(op, value, vals.min(), vals.max()):
+                ok = False
+                break
+        if ok:
+            keep.append(i)
+    if counters is not None:
+        counters["row_groups_total"] = counters.get("row_groups_total", 0) \
+            + n_stripes
+        counters["row_groups_read"] = counters.get("row_groups_read", 0) \
+            + len(keep)
+    out: List[HostBatch] = []
+    for i in keep:
+        hb = arrow_to_host_batch(f.read_stripe(i, columns=columns or None))
+        for j in range(0, hb.num_rows, batch_rows):
+            out.append(hb.slice(j, min(batch_rows, hb.num_rows - j)))
+    return out
 
 
 def _read_csv_file(path: str, columns: List[str], batch_rows: int,
@@ -220,7 +272,8 @@ class CpuFileScanExec(CpuExec):
             batches = _read_parquet_file(path, columns, batch_rows,
                                          self.descriptors, counters)
         elif self.fmt == "orc":
-            batches = _read_orc_file(path, columns, batch_rows)
+            batches = _read_orc_file(path, columns, batch_rows,
+                                     self.descriptors, counters)
         elif self.fmt == "csv":
             batches = _read_csv_file(path, columns, batch_rows, self.options)
         else:

@@ -89,3 +89,31 @@ def test_array_column_blocks_tpu_sort():
     df.order_by("k").collect()
     assert "cannot run on TPU" in s.last_explain \
         and "array columns" in s.last_explain
+
+
+def test_get_item_and_size():
+    def q(s):
+        df = s.create_dataframe(DATA, num_partitions=2)
+        return df.select(
+            "k",
+            F.get_item("arr", 0).alias("first"),
+            F.get_item("arr", 2).alias("third"),
+            F.size("arr").alias("n"))
+    assert_tpu_cpu_equal(q)
+    s = tpu_session()
+    rows = q(s).collect()
+    got = {r[0]: r[1:] for r in rows}
+    assert got["a"] == (1, 3, 3)
+    assert got["b"] == (None, None, 0)
+    assert got["d"] == (None, None, None)
+
+
+def test_get_item_negative_ordinal_is_null():
+    """Spark semantics: negative ordinals are out of range -> NULL (not
+    python tail indexing) — on both engines, including NULL-array rows."""
+    def q(s):
+        df = s.create_dataframe(DATA, num_partitions=2)
+        return df.select("k", F.get_item("arr", -1).alias("m"))
+    assert_tpu_cpu_equal(q)
+    s = tpu_session()
+    assert all(r[1] is None for r in q(s).collect())

@@ -185,3 +185,61 @@ def test_partition_pruning_skips_files(tmp_path):
     # physical plan pruned to only the k=a file
     plan = s.last_physical_plan.tree_string()
     assert "1 files" in plan, plan
+
+
+def test_orc_stripe_pushdown(tmp_path):
+    """ORC stripe skipping: selective predicate decodes fewer stripes
+    (OrcFilters/SearchArgument role)."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.orc as orc
+    out = str(tmp_path / "sorted_orc")
+    os.makedirs(out)
+    n = 50_000
+    tb = pa.table({"k": np.arange(n, dtype=np.int64),
+                   "v": np.arange(n, dtype=np.float64) * 0.5})
+    orc.write_table(tb, os.path.join(out, "part-0.orc"),
+                    stripe_size=64 * 1024)
+    s = tpu_session()
+    d = s.read.orc(out)
+    nst = orc.ORCFile(os.path.join(out, "part-0.orc")).nstripes
+    assert nst > 2
+    got = d.filter(d["k"] < 1000).collect()
+    assert len(got) == 1000
+    ms = _scan_metrics(s)
+    assert ms.get("rowGroupsTotal") == nst
+    assert 0 < ms.get("rowGroupsRead", 0) < nst, ms
+
+
+def test_orc_pushdown_correctness(tmp_path):
+    s = tpu_session()
+    df = s.create_dataframe(DATA, num_partitions=2)
+    out = str(tmp_path / "orc_pd")
+    df.write_orc(out)
+
+    def q(sess):
+        d = sess.read.orc(out)
+        return d.filter((d["i"] > 2) & d["l"].is_not_null())
+
+    assert_tpu_cpu_equal(q)
+
+
+def test_orc_pushdown_keeps_nan_stripes(tmp_path):
+    """NaN in a stripe must not poison the computed min/max into skipping
+    rows that genuinely match (plain min() would propagate NaN and fail
+    every range test)."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.orc as orc
+    out = str(tmp_path / "nan_orc")
+    os.makedirs(out)
+    n = 20_000
+    v = np.linspace(0.0, 1.0, n)
+    v[::97] = np.nan  # NaN sprinkled through every stripe
+    tb = pa.table({"v": v, "k": np.arange(n, dtype=np.int64)})
+    orc.write_table(tb, os.path.join(out, "p.orc"), stripe_size=64 * 1024)
+    s = tpu_session()
+    d = s.read.orc(out)
+    got = d.filter(d["v"] < 0.5).collect()
+    expect = sum(1 for x in v if x == x and x < 0.5)
+    assert len(got) == expect
