@@ -319,6 +319,33 @@ class DataFrame:
         node = L.Generate(column, alias or "col", pos, outer, self.plan)
         return DataFrame(node, self.session)
 
+    def window_in_pandas(self, partition_by, specs) -> "DataFrame":
+        """Whole-partition pandas window columns: specs is
+        {out_name: (fn, dtype, col)} with fn(pd.Series) -> scalar,
+        broadcast over each partition (GpuWindowInPandasExec analogue).
+
+        ``partition_by`` must name existing columns (project expression
+        keys first); ``out_name``s must not collide with input columns.
+        """
+        names = []
+        for c in partition_by:
+            if not isinstance(c, str) or c not in self.schema:
+                raise TypeError(
+                    f"window_in_pandas partition key must be an existing "
+                    f"column name, got {c!r}; project expressions first")
+            names.append(c)
+        for n in specs:
+            if n in self.schema:
+                raise ValueError(
+                    f"window_in_pandas output {n!r} collides with an "
+                    "input column")
+        keys = [self._resolve(ColumnRef(n)) for n in names]
+        win_specs = [(n, fn, dt, col)
+                     for n, (fn, dt, col) in specs.items()]
+        return DataFrame(
+            L.WindowInPandas(keys, names, win_specs, self.plan),
+            self.session)
+
     def map_in_pandas(self, fn, schema) -> "DataFrame":
         """fn(Iterator[pd.DataFrame]) -> Iterator[pd.DataFrame] per
         partition (GpuMapInPandasExec analogue)."""

@@ -136,8 +136,13 @@ class Count(AggregateFunction):
 
     def segment_update(self, v, seg_ids, num_segments, live_mask):
         use = v.validity & live_mask
-        c = jax.ops.segment_sum(use.astype(jnp.int64), seg_ids,
-                                num_segments=num_segments, indices_are_sorted=True)
+        # scatter-add in i32 (native TPU lanes; a 64-bit scatter lowers to
+        # an emulated sort-based path), widen after: one batch holds
+        # < 2^31 rows so the per-batch count cannot overflow
+        c32 = jax.ops.segment_sum(use.astype(jnp.int32), seg_ids,
+                                  num_segments=num_segments,
+                                  indices_are_sorted=True)
+        c = c32.astype(jnp.int64)
         return [DevVal(T.LONG, c, jnp.ones(num_segments, dtype=jnp.bool_))]
 
     def segment_merge(self, buffers, seg_ids, num_segments, live_mask):
@@ -236,8 +241,10 @@ class Average(AggregateFunction):
         x = v.data.astype(jnp.float64)
         s = jax.ops.segment_sum(jnp.where(use, x, 0.0), seg_ids,
                                 num_segments=num_segments, indices_are_sorted=True)
-        c = jax.ops.segment_sum(use.astype(jnp.int64), seg_ids,
-                                num_segments=num_segments, indices_are_sorted=True)
+        # count in i32 (native scatter lanes), widened after — see Count
+        c = jax.ops.segment_sum(use.astype(jnp.int32), seg_ids,
+                                num_segments=num_segments,
+                                indices_are_sorted=True).astype(jnp.int64)
         ones = jnp.ones(num_segments, dtype=jnp.bool_)
         return [DevVal(T.DOUBLE, s, ones), DevVal(T.LONG, c, ones)]
 

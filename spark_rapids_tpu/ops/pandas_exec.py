@@ -6,6 +6,7 @@ Reference analogues (sql-plugin/.../execution/python/):
 * GpuFlatMapGroupsInPandasExec — :class:`CpuFlatMapGroupsInPandasExec`
 * GpuFlatMapCoGroupsInPandasExec — :class:`CpuFlatMapCoGroupsInPandasExec`
 * GpuAggregateInPandasExec — :class:`CpuAggregateInPandasExec`
+* GpuWindowInPandasExec — :class:`CpuWindowInPandasExec`
 
 Like the reference, the engine side of these ops is data movement: device
 batches come back to host columnar form, python runs under the
@@ -213,3 +214,36 @@ def _is_nan(x) -> bool:
         return x is None or (isinstance(x, float) and x != x)
     except TypeError:
         return False
+
+
+class CpuWindowInPandasExec(CpuExec):
+    """Unbounded-frame pandas window (GpuWindowInPandasExec analogue):
+    fn(group pd.Series) -> scalar, broadcast to every row of the
+    partition; all input columns pass through."""
+
+    def __init__(self, key_names: List[str], win_specs, child: PhysicalOp,
+                 schema: T.Schema):
+        super().__init__([child], schema)
+        self.key_names = key_names
+        self.win_specs = win_specs
+
+    def describe(self):
+        return f"CpuWindowInPandas(keys={self.key_names})"
+
+    def partitions(self, ctx: ExecContext):
+        def gen(part):
+            batches = list(part)
+            if not batches:
+                return
+            pdf = _to_pandas(HostBatch.concat(batches))
+            with python_worker_slot(ctx):
+                grouped = pdf.groupby(self.key_names, dropna=False,
+                                      sort=False)
+                for name, fn, _dt, col in self.win_specs:
+                    pdf[name] = grouped[col].transform(
+                        lambda s, fn=fn: fn(s))
+            hb = pandas_to_host_batch(pdf, self.output_schema)
+            if hb.num_rows:
+                yield hb
+
+        return [gen(p) for p in self.children[0].partitions(ctx)]

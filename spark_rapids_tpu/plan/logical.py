@@ -530,3 +530,25 @@ class Generate(LogicalPlan):
     def describe(self):
         kind = "posexplode" if self.pos else "explode"
         return f"Generate({kind}({self.column}) as {self.alias})"
+
+
+class WindowInPandas(LogicalPlan):
+    """Whole-partition-frame pandas window: each output row carries
+    fn(partition pd.Series) broadcast over its partition
+    (GpuWindowInPandasExec analogue — unbounded preceding/following frame,
+    the shape pyspark's GROUPED_AGG pandas_udf over a Window takes)."""
+
+    def __init__(self, keys: List[Expression], key_names: List[str],
+                 win_specs, child: LogicalPlan):
+        self.keys = keys
+        self.key_names = key_names
+        self.win_specs = win_specs  # list of (out_name, fn, dtype, col)
+        self.children = (child,)
+
+    @property
+    def schema(self):
+        child = self.children[0].schema
+        fields = list(child.fields)
+        fields += [T.Field(n, dt, True)
+                   for n, _fn, dt, _c in self.win_specs]
+        return T.Schema(fields)

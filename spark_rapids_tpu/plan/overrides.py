@@ -190,7 +190,7 @@ class TpuOverrides:
                 meta.will_not_work(f"explode needs an array, got {arr.dtype}")
         elif isinstance(node, (L.MapInPandas, L.FlatMapGroupsInPandas,
                                L.FlatMapCoGroupsInPandas,
-                               L.AggregateInPandas)):
+                               L.AggregateInPandas, L.WindowInPandas)):
             meta.will_not_work(
                 "pandas exec runs python via the host Arrow path "
                 "(GpuArrowEvalPythonExec data flow)")
@@ -327,6 +327,14 @@ class TpuOverrides:
             ex = CpuShuffleExchangeExec(part, _to_host(conv[0]))
             return CpuAggregateInPandasExec(node.key_names, node.agg_specs,
                                             ex, node.schema)
+        if isinstance(node, L.WindowInPandas):
+            from spark_rapids_tpu.ops.pandas_exec import (
+                CpuWindowInPandasExec,
+            )
+            part = HashPartitioning(node.keys, self._shuffle_parts())
+            ex = CpuShuffleExchangeExec(part, _to_host(conv[0]))
+            return CpuWindowInPandasExec(node.key_names, node.win_specs,
+                                         ex, node.schema)
         if isinstance(node, L.Window):
             from spark_rapids_tpu.ops.window import (
                 CpuWindowExec, TpuWindowExec,

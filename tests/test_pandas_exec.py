@@ -129,3 +129,29 @@ def test_worker_semaphore_bounds_concurrency():
     for t in threads:
         t.join()
     assert max(peak) <= 2
+
+
+def test_window_in_pandas():
+    s = tpu_session()
+    df = s.create_dataframe(DATA, num_partitions=3)
+    out = df.window_in_pandas(
+        ["k"], {"vmean": (lambda ser: float(ser.mean()), T.DOUBLE, "v")})
+    rows = out.collect()
+    assert len(rows) == 8
+    by_key = {}
+    for r in rows:
+        by_key.setdefault(r[0], set()).add(r[3])
+    # group a: v=1,3,6 -> mean 10/3 on every row of the partition
+    assert by_key["a"] == {10.0 / 3.0}
+    assert by_key["b"] == {3.5}
+
+
+def test_window_in_pandas_validates_inputs():
+    import pytest as _pytest
+    s = tpu_session()
+    df = s.create_dataframe(DATA)
+    with _pytest.raises(TypeError):
+        df.window_in_pandas([df["v"]], {"m": (lambda s_: 0.0, T.DOUBLE,
+                                              "v")})
+    with _pytest.raises(ValueError):
+        df.window_in_pandas(["k"], {"v": (lambda s_: 0.0, T.DOUBLE, "v")})
