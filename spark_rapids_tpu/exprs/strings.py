@@ -51,12 +51,22 @@ _HASH_BASES = (31, 131)
 
 
 def _pow_table(base: int, n: int):
-    # uint32 modular polynomial powers (wraps mod 2^32 — native on TPU;
-    # u64 arithmetic would be emulated)
-    return jnp.concatenate([
-        jnp.ones(1, dtype=jnp.uint32),
-        jnp.cumprod(jnp.full(n, base, dtype=jnp.uint32)),
-    ])
+    """uint32 modular polynomial powers base^k (mod 2^32) for k in [0, n].
+
+    Closed form via binary exponentiation: 32 elementwise multiplies
+    selected by k's bits, with base^(2^j) precomputed in python.  A
+    ``cumprod`` scan here compiles pathologically on TPU at byte-buffer
+    sizes (the scan lowering, same family as the f64 cumsum blowup);
+    the bit form is pure elementwise work.
+    """
+    k = jnp.arange(n + 1, dtype=jnp.uint32)
+    out = jnp.ones(n + 1, dtype=jnp.uint32)
+    sq = base % (1 << 32)
+    for j in range(max(n, 1).bit_length()):
+        bit = (k >> jnp.uint32(j)) & jnp.uint32(1)
+        out = out * jnp.where(bit == 1, jnp.uint32(sq), jnp.uint32(1))
+        sq = (sq * sq) % (1 << 32)
+    return out
 
 
 def string_hash2(v: DevVal) -> Tuple[jnp.ndarray, jnp.ndarray]:

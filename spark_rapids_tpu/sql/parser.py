@@ -573,6 +573,42 @@ def _build_function(name: str, args: List[Expression], star: bool,
     if name == "if":
         from spark_rapids_tpu.exprs.conditional import If
         return If(args[0], args[1], args[2])
+    if name == "replace":
+        return S.StringReplace(args[0], args[1], args[2])
+    if name == "regexp_replace":
+        return S.RegExpReplace(args[0], args[1], args[2])
+    if name == "split_part":
+        return S.SplitPart(args[0], args[1], args[2].value)
+    if name == "concat_ws":
+        sep = args[0].value if hasattr(args[0], "value") else str(args[0])
+        return S.ConcatWs(sep, *args[1:])
+    if name in ("lpad", "rpad"):
+        cls = S.StringLPad if name == "lpad" else S.StringRPad
+        pad = args[2].value if len(args) > 2 else " "
+        return cls(args[0], args[1].value, pad)
+    if name == "unix_timestamp":
+        return D.UnixTimestamp(args[0])
+    if name == "from_unixtime":
+        if len(args) > 1:
+            return D.FromUnixTime(args[0], args[1].value)
+        return D.FromUnixTime(args[0])
+    if name in ("shiftleft", "shiftright", "shiftrightunsigned"):
+        from spark_rapids_tpu.exprs.bitwise import (
+            ShiftLeft, ShiftRight, ShiftRightUnsigned,
+        )
+        cls = {"shiftleft": ShiftLeft, "shiftright": ShiftRight,
+               "shiftrightunsigned": ShiftRightUnsigned}[name]
+        return cls(args[0], args[1])
+    if name == "size":
+        from spark_rapids_tpu.exprs.misc import ArraySize
+        return ArraySize(args[0])
+    if name == "array":
+        from spark_rapids_tpu.exprs.misc import CreateArray
+        return CreateArray(*args)
+    if name == "element_at":
+        # SQL element_at is 1-based; engine ordinals are 0-based
+        from spark_rapids_tpu.exprs.misc import GetArrayItem
+        return GetArrayItem(args[0], int(args[1].value) - 1)
     raise SyntaxError(f"unknown function {name}")
 
 

@@ -72,3 +72,24 @@ def test_sql_cross_and_semi():
         "SELECT s.item, i.item_sk FROM store s CROSS JOIN items i",
     ]:
         assert_tpu_cpu_equal(run_sql(q))
+
+
+def test_sql_new_string_datetime_bitwise_functions():
+    from compare import assert_tpu_cpu_equal
+    data = {
+        "s": ["a-b-c", "x-y", None, "plain"],
+        "n": [3, 12, 7, 1],
+        "t": [0, 1_600_000_000, 100, 200],
+    }
+
+    def q(sess):
+        df = sess.create_dataframe(data)
+        df.create_or_replace_temp_view("t1")
+        return sess.sql(
+            "SELECT split_part(s, '-', 2) AS p2, "
+            "       regexp_replace(s, '[-]', '_') AS u, "
+            "       concat_ws('/', s, s) AS d, "
+            "       shiftleft(n, 1) AS n2, "
+            "       from_unixtime(t) AS ts "
+            "FROM t1")
+    assert_tpu_cpu_equal(q)
