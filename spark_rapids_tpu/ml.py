@@ -32,8 +32,11 @@ def to_device_batches(df) -> List[ColumnBatch]:
         semaphore=session.runtime.semaphore if session.runtime else None,
         device=session.runtime.device if session.runtime else None)
     out: List[ColumnBatch] = []
-    for part in phys.partitions(ctx):
-        out.extend(part)
+    try:
+        for part in phys.partitions(ctx):
+            out.extend(part)
+    finally:
+        ctx.close_deferred()
     return out
 
 

@@ -271,6 +271,15 @@ class TpuShuffleExchangeExec(TpuExec):
         # spillable shuffle catalog (RapidsShuffleInternalManager.scala:
         # 91-154, ShuffleBufferCatalog).  Register every piece so the budget
         # can push early partitions to host while later ones materialize.
+        #
+        # The split is memoized per query context: a task RETRY re-reads
+        # the already-materialized (spillable) pieces instead of re-running
+        # the whole upstream subtree — the role persisted shuffle files
+        # play for Spark's task retry.  Handles stay open until the query
+        # ends (ctx.close_deferred).
+        cached = getattr(self, "_split_cache", None)
+        if cached is not None and cached[0] is ctx:
+            return [self._drain_cached(p) for p in cached[1]]
         catalog = DeviceRuntime.get(ctx.conf).catalog
         out: List[List] = [[] for _ in range(n)]
         for pi, batches in enumerate(all_batches):
@@ -301,6 +310,7 @@ class TpuShuffleExchangeExec(TpuExec):
                                     out_byte_caps=bcaps or None)
                     h = catalog.register(piece, PRIORITY_SHUFFLE_OUTPUT)
                     h.piece_rows = cnt  # host-known: no sync for AQE sizing
+                    ctx.defer_close(h)
                     out[p].append(h)
                     offset += cnt
 
@@ -308,15 +318,16 @@ class TpuShuffleExchangeExec(TpuExec):
         # batches just to count rows (GpuCustomShuffleReaderExec's use of
         # map-status sizes)
         self._last_part_rows = [sum(h.piece_rows for h in p) for p in out]
+        self._split_cache = (ctx, out)
+        return [self._drain_cached(p) for p in out]
 
-        def drain(handles):
-            # lazy: each piece unspills only when the consumer reaches it
-            for h in handles:
-                b = h.get()
-                h.close()
-                yield b
-
-        return [drain(p) for p in out]
+    @staticmethod
+    def _drain_cached(handles):
+        # lazy: each piece unspills only when the consumer reaches it;
+        # handles stay registered (spillable + retry-reusable) until the
+        # query closes them
+        for h in handles:
+            yield h.get()
 
 
 def _mesh_partitioning(p: Partitioning, n: int) -> Partitioning:

@@ -52,6 +52,18 @@ class ExecContext:
         # then run lax.all_to_all instead of the single-host split
         self.mesh = mesh
         self.metrics: Dict[str, Dict[str, Metric]] = {}
+        # spillable handles whose lifetime is the whole query (shuffle
+        # outputs survive partition retries, like the reference's shuffle
+        # files); collect_host closes them when the query ends
+        self._deferred_handles: List = []
+
+    def defer_close(self, handle) -> None:
+        self._deferred_handles.append(handle)
+
+    def close_deferred(self) -> None:
+        for h in self._deferred_handles:
+            h.close()
+        self._deferred_handles.clear()
 
     def metric(self, op_id: str, name: str) -> Metric:
         ops = self.metrics.setdefault(op_id, {})
@@ -186,31 +198,34 @@ def run_partition_with_retry(root: PhysicalOp, ctx: ExecContext,
 
 def collect_host(op: PhysicalOp, ctx: ExecContext) -> HostBatch:
     """Drive a plan to completion and concatenate all partitions on host."""
-    if op.is_tpu:
-        from spark_rapids_tpu.plan.pipeline import pipeline_collect
-        hb = pipeline_collect(op, ctx)
-        if hb is not None:
-            return hb
-    root = op if not op.is_tpu else DeviceToHostExec(op)
-    batches: List[HostBatch] = []
-    t0 = time.monotonic()
-    parts = root.partitions(ctx)
-    for i, part in enumerate(parts):
-        try:
-            got = list(part)
-        except MemoryError:
-            raise
-        except Exception:
-            got = run_partition_with_retry(root, ctx, i)
-        batches.extend(got)
-        ctx.metric("collect", "batches").add(len(got))
-    ctx.metric("collect", "wallTimeNs").add(
-        int((time.monotonic() - t0) * 1e9))
-    if not batches:
-        return HostBatch(op.output_schema, [
-            _empty_host_col(f) for f in op.output_schema.fields
-        ])
-    return HostBatch.concat(batches)
+    try:
+        if op.is_tpu:
+            from spark_rapids_tpu.plan.pipeline import pipeline_collect
+            hb = pipeline_collect(op, ctx)
+            if hb is not None:
+                return hb
+        root = op if not op.is_tpu else DeviceToHostExec(op)
+        batches: List[HostBatch] = []
+        t0 = time.monotonic()
+        parts = root.partitions(ctx)
+        for i, part in enumerate(parts):
+            try:
+                got = list(part)
+            except MemoryError:
+                raise
+            except Exception:
+                got = run_partition_with_retry(root, ctx, i)
+            batches.extend(got)
+            ctx.metric("collect", "batches").add(len(got))
+        ctx.metric("collect", "wallTimeNs").add(
+            int((time.monotonic() - t0) * 1e9))
+        if not batches:
+            return HostBatch(op.output_schema, [
+                _empty_host_col(f) for f in op.output_schema.fields
+            ])
+        return HostBatch.concat(batches)
+    finally:
+        ctx.close_deferred()
 
 
 def _empty_host_col(f: T.Field):

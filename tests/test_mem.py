@@ -123,3 +123,57 @@ def test_join_spills_under_tiny_budget():
         assert mem.get("unspilled", 0) > 0, mem
     finally:
         DeviceRuntime.reset()
+
+
+def test_exchange_split_memoized_for_retry():
+    """A task retry re-reads the already-materialized shuffle pieces
+    instead of re-running the split (the role persisted shuffle files play
+    for Spark's retry); handles close when the query ends."""
+    import numpy as np
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.plan.physical import ExecContext
+    from spark_rapids_tpu.runtime.device import DeviceRuntime
+    from spark_rapids_tpu.session import TpuSparkSession
+
+    DeviceRuntime.reset()
+    try:
+        conf = RapidsConf({
+            "spark.rapids.sql.enabled": True,
+            "spark.sql.shuffle.partitions": 4,
+            "spark.rapids.sql.tpu.exchange.collapseLocal": False,
+        })
+        s = TpuSparkSession(conf)
+        df = s.create_dataframe(
+            {"k": list(range(100)), "v": list(range(100))},
+            num_partitions=3)
+        phys = s.plan_physical(df.group_by("k").sum("v").plan)
+        # find the exchange in the plan
+        def find_ex(op):
+            from spark_rapids_tpu.parallel.exchange import (
+                TpuShuffleExchangeExec,
+            )
+            if isinstance(op, TpuShuffleExchangeExec):
+                return op
+            for c in op.children:
+                r = find_ex(c)
+                if r is not None:
+                    return r
+            return None
+
+        ex = find_ex(phys)
+        assert ex is not None
+        ctx = ExecContext(conf, device=s.runtime.device)
+        parts1 = ex.partitions(ctx)
+        first = [list(p) for p in parts1]
+        cache = ex._split_cache
+        parts2 = ex.partitions(ctx)  # the retry path
+        assert ex._split_cache is cache  # no recompute
+        second = [list(p) for p in parts2]
+        assert [len(p) for p in first] == [len(p) for p in second]
+        n_open = len(ctx._deferred_handles)
+        assert n_open > 0
+        ctx.close_deferred()
+        assert all(h.closed for h in ctx._deferred_handles) or \
+            not ctx._deferred_handles
+    finally:
+        DeviceRuntime.reset()
