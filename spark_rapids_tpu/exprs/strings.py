@@ -829,36 +829,47 @@ def _regex_as_byte_class(pattern: str) -> Optional[bytes]:
     """The set of single bytes a regex char-class matches, or None.
 
     Supports ``[abc]`` and ``[a-z0-9]`` style classes over ASCII (no
-    negation, no nested escapes beyond ``\\]``-type literals).
+    negation); escaped members and range ENDPOINTS (``[\\.-0]``) parse as
+    one item each, so ranges with escaped endpoints are exact.
     """
     if len(pattern) < 3 or pattern[0] != "[" or pattern[-1] != "]":
         return None
     inner = pattern[1:-1]
-    if inner.startswith("^"):
+    if inner.startswith("^") or not inner:
         return None
-    members = set()
-    i = 0
-    while i < len(inner):
+
+    def parse_item(i):
+        """(char, next_i) for one literal-or-escaped class member."""
         ch = inner[i]
         if ch == "\\":
             if i + 1 >= len(inner):
                 return None
-            ch = inner[i + 1]
-            if ch not in _REGEX_META and ch != "-":
+            nxt = inner[i + 1]
+            if nxt not in _REGEX_META and nxt != "-":
+                return None  # \d, \s ... not a single char
+            return nxt, i + 2
+        return ch, i + 1
+
+    members = set()
+    i = 0
+    while i < len(inner):
+        item = parse_item(i)
+        if item is None:
+            return None
+        lo_ch, i = item
+        if i < len(inner) and inner[i] == "-" and i + 1 < len(inner):
+            hi_item = parse_item(i + 1)
+            if hi_item is None:
                 return None
-            i += 2
-        elif i + 2 < len(inner) and inner[i + 1] == "-":
-            lo, hi = ord(inner[i]), ord(inner[i + 2])
+            hi_ch, i = hi_item
+            lo, hi = ord(lo_ch), ord(hi_ch)
             if lo > hi or hi > 127:
                 return None
             members.update(chr(c) for c in range(lo, hi + 1))
-            i += 3
             continue
-        else:
-            i += 1
-        if ord(ch) > 127:
+        if ord(lo_ch) > 127:
             return None
-        members.add(ch)
+        members.add(lo_ch)
     if not members:
         return None
     return bytes(sorted(ord(c) for c in members))
@@ -938,7 +949,11 @@ class RegExpReplace(Expression):
             raise NotImplementedError(
                 "regexp_replace pattern/replacement must be literals")
         rx = re.compile(pat)
-        out = np.array([rx.sub(repl, str(s)) for s in v.values],
+        # LITERAL replacement (lambda sidesteps python's \\-template
+        # expansion, which crashes on '\\U...' and renders '$1' literally
+        # anyway) — matches the TPU path; Java $-group references are a
+        # documented non-feature (docs/compatibility.md).
+        out = np.array([rx.sub(lambda _m: repl, str(s)) for s in v.values],
                        dtype=object)
         return CpuVal(T.STRING, out, v.validity)
 
@@ -953,6 +968,9 @@ class SplitPart(Expression):
             delimiter = Literal(str(delimiter), T.STRING)
         self.children = (child, delimiter)
         self.part = int(part)
+        if self.part == 0:
+            # Spark raises for partNum 0 (ANSI and non-ANSI alike)
+            raise ValueError("split_part: partNum must not be 0")
         self.dtype = T.STRING
         self.nullable = child.nullable
 
@@ -963,8 +981,8 @@ class SplitPart(Expression):
         d = _literal_needle(self.children[1])
         if d is None or d == "":
             return "split delimiter must be a non-empty literal"
-        if self.part < 1:
-            return "negative/zero part numbers run on CPU"
+        if self.part < 0:
+            return "negative part numbers run on CPU"
         if _has_self_overlap(d.encode("utf-8")):
             return "split delimiter can self-overlap (CPU only)"
         return None

@@ -144,15 +144,23 @@ class CpuFlatMapCoGroupsInPandasExec(CpuExec):
                                   else f.dtype.np_dtype)
                 for f in schema.fields})
 
+        def norm_key(k):
+            # pandas nulls group under NaN, and NaN != NaN would keep the
+            # two sides' null groups from pairing — canonicalize to None
+            parts = k if isinstance(k, tuple) else (k,)
+            return tuple(None if (p is None or (isinstance(p, float)
+                                                and p != p)) else p
+                         for p in parts)
+
         def gen(lp, rp):
             lbs, rbs = list(lp), list(rp)
             lpdf = _to_pandas(HostBatch.concat(lbs)) if lbs else \
                 empty_pdf(lsch)
             rpdf = _to_pandas(HostBatch.concat(rbs)) if rbs else \
                 empty_pdf(rsch)
-            lgroups = {k: g for k, g in lpdf.groupby(
+            lgroups = {norm_key(k): g for k, g in lpdf.groupby(
                 self.left_names, dropna=False)} if len(lpdf) else {}
-            rgroups = {k: g for k, g in rpdf.groupby(
+            rgroups = {norm_key(k): g for k, g in rpdf.groupby(
                 self.right_names, dropna=False)} if len(rpdf) else {}
             keys = sorted(set(lgroups) | set(rgroups),
                           key=lambda k: (str(k),))

@@ -268,13 +268,14 @@ def _aqe_target_rows(ctx) -> int:
     return AQE_TARGET_ROWS.get(ctx.conf)
 
 
-def _coalesce_partition_lists(parts: List[List[ColumnBatch]],
-                              sizes: List[int], target: int
-                              ) -> List[List[ColumnBatch]]:
-    """Group consecutive partitions until each group reaches target rows."""
+def _group_by_target(items: List, sizes: List[int], target: int
+                     ) -> List[List]:
+    """Group consecutive items until each group reaches target rows — the
+    ONE AQE grouping rule, shared by the shuffle reader, the aggregate
+    merge and the shuffled join (which groups (left, right) pairs)."""
     groups, cur, cur_rows = [], [], 0
-    for pp, sz in zip(parts, sizes):
-        cur.extend(pp)
+    for it, sz in zip(items, sizes):
+        cur.append(it)
         cur_rows += sz
         if cur_rows >= target:
             groups.append(cur)
@@ -282,6 +283,14 @@ def _coalesce_partition_lists(parts: List[List[ColumnBatch]],
     if cur or not groups:
         groups.append(cur)
     return groups
+
+
+def _coalesce_partition_lists(parts: List[List[ColumnBatch]],
+                              sizes: List[int], target: int
+                              ) -> List[List[ColumnBatch]]:
+    """Group consecutive partitions until each group reaches target rows."""
+    return [[b for p in g for b in p]
+            for g in _group_by_target(parts, sizes, target)]
 
 
 class TpuCoalescedShuffleReaderExec(TpuExec):
@@ -315,16 +324,8 @@ class TpuCoalescedShuffleReaderExec(TpuExec):
         if rows is not None and len(rows) == len(lazy_parts):
             # spill-friendly path: sizes came with the shuffle (no unspill
             # just to count rows); chain the lazy generators per group
-            target = _aqe_target_rows(ctx)
-            groups, cur, cur_rows = [], [], 0
-            for p, sz in zip(lazy_parts, rows):
-                cur.append(p)
-                cur_rows += sz
-                if cur_rows >= target:
-                    groups.append(cur)
-                    cur, cur_rows = [], 0
-            if cur or not groups:
-                groups.append(cur)
+            groups = _group_by_target(lazy_parts, rows,
+                                      _aqe_target_rows(ctx))
             ctx.metric(self.op_id, "coalescedTo").add(len(groups))
             return [itertools.chain(*g) for g in groups]
         parts = [list(p) for p in lazy_parts]
@@ -639,16 +640,8 @@ class TpuHashAggregateExec(TpuExec):
                 rows = getattr(child, "_last_part_rows", None)
                 if rows is not None and len(rows) == len(lazy_parts):
                     # spill-friendly: shuffle-known sizes, lazy chaining
-                    groups, cur, cur_rows = [], [], 0
-                    for p, sz in zip(lazy_parts, rows):
-                        cur.append(p)
-                        cur_rows += sz
-                        if cur_rows >= target:
-                            groups.append(cur)
-                            cur, cur_rows = [], 0
-                    if cur or not groups:
-                        groups.append(cur)
-                    parts = [itertools.chain(*g) for g in groups]
+                    parts = [itertools.chain(*g) for g in
+                             _group_by_target(lazy_parts, rows, target)]
                 else:
                     mats = [list(p) for p in lazy_parts]
                     # one round trip for every batch's sizes across ALL
@@ -780,19 +773,12 @@ class TpuShuffledHashJoinExec(TpuExec):
                 sizes = [sum(by_id[id(b)] for b in lp) +
                          sum(by_id[id(b)] for b in rp)
                          for lp, rp in zip(lparts, rparts)]
-            target = _aqe_target_rows(ctx)
-            groups, cur_l, cur_r, cur_rows = [], [], [], 0
-            for lp, rp, sz in zip(lparts, rparts, sizes):
-                cur_l.append(lp)
-                cur_r.append(rp)
-                cur_rows += sz
-                if cur_rows >= target:
-                    groups.append((cur_l, cur_r))
-                    cur_l, cur_r, cur_rows = [], [], 0
-            if cur_l or cur_r or not groups:
-                groups.append((cur_l, cur_r))
-            lparts = [itertools.chain(*g[0]) for g in groups]
-            rparts = [itertools.chain(*g[1]) for g in groups]
+            groups = _group_by_target(list(zip(lparts, rparts)), sizes,
+                                      _aqe_target_rows(ctx))
+            lparts = [itertools.chain(*(lp for lp, _ in g))
+                      for g in groups]
+            rparts = [itertools.chain(*(rp for _, rp in g))
+                      for g in groups]
 
         def gen(lp, rp):
             lbs, rbs = list(lp), list(rp)

@@ -276,9 +276,12 @@ class TpuShuffleExchangeExec(TpuExec):
         # the already-materialized (spillable) pieces instead of re-running
         # the whole upstream subtree — the role persisted shuffle files
         # play for Spark's task retry.  Handles stay open until the query
-        # ends (ctx.close_deferred).
+        # ends (ctx.close_deferred).  The cache holds the ctx via weakref:
+        # exec nodes live as long as the session's plan cache, and a strong
+        # ref would pin a finished query's whole object graph.
+        import weakref
         cached = getattr(self, "_split_cache", None)
-        if cached is not None and cached[0] is ctx:
+        if cached is not None and cached[0]() is ctx:
             return [self._drain_cached(p) for p in cached[1]]
         catalog = DeviceRuntime.get(ctx.conf).catalog
         out: List[List] = [[] for _ in range(n)]
@@ -318,7 +321,7 @@ class TpuShuffleExchangeExec(TpuExec):
         # batches just to count rows (GpuCustomShuffleReaderExec's use of
         # map-status sizes)
         self._last_part_rows = [sum(h.piece_rows for h in p) for p in out]
-        self._split_cache = (ctx, out)
+        self._split_cache = (weakref.ref(ctx), out)
         return [self._drain_cached(p) for p in out]
 
     @staticmethod

@@ -52,6 +52,10 @@ class TpuSemaphore:
             self._held.depth = 0
             self._sem.release()
 
+    def held_depth(self) -> int:
+        """This thread's re-entrant hold depth (0 = no permit held)."""
+        return getattr(self._held, "depth", 0)
+
 
 class DeviceRuntime:
     """Process-wide device services (GpuDeviceManager analogue)."""

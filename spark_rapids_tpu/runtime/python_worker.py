@@ -41,10 +41,16 @@ def _semaphore(conf: RapidsConf) -> threading.Semaphore:
 @contextlib.contextmanager
 def python_worker_slot(ctx):
     """Bound python concurrency; release the device semaphore while python
-    runs (the GpuSemaphore release in GpuArrowEvalPythonExec.scala:484)."""
+    runs (the GpuSemaphore release in GpuArrowEvalPythonExec.scala:484).
+
+    Only a permit this thread actually HOLDS is released/re-acquired —
+    release() at depth 0 is a no-op, so blindly re-acquiring afterwards
+    would leak a permit and eventually deadlock device admission.
+    """
     sem = _semaphore(ctx.conf)
     released_device = False
-    if ctx.semaphore is not None:
+    if ctx.semaphore is not None and \
+            getattr(ctx.semaphore, "held_depth", lambda: 0)() > 0:
         ctx.semaphore.release()
         released_device = True
     sem.acquire()

@@ -443,3 +443,35 @@ class TestUnixTime:
             return df.select(F.from_unixtime("s", "yyyy/MM/dd"))
 
         assert_tpu_cpu_equal(build, expect_fallback="FromUnixTime")
+
+
+class TestRegExpReplaceEdges:
+    def test_escaped_range_endpoint_class(self):
+        from spark_rapids_tpu.exprs import RegExpReplace
+        # [\.-0] is the range '.'..'0' = {., /, 0}
+        data = {"s": (T.STRING, ["a/b-c", "x.y0z"])}
+        assert run_both(
+            RegExpReplace(ColumnRef("s"), Literal(r"[\.-0]"), Literal("")),
+            data) == ["ab-c", "xyz"]
+
+    def test_literal_backslash_replacement(self):
+        # replacement is literal text (no python-re template expansion
+        # crash on \U, no '$1' group references); 'a+' is a real regex so
+        # the planner routes to the CPU re path
+        from tests.compare import assert_tpu_cpu_equal
+        from spark_rapids_tpu import functions as F
+
+        def build(s):
+            df = s.create_dataframe({"s": ["aaa b", "nope"]})
+            return df.select(
+                F.regexp_replace("s", "a+", "C:\\Users").alias("r"))
+
+        assert_tpu_cpu_equal(build, expect_fallback="RegExpReplace")
+        from tests.compare import tpu_session
+        s = tpu_session()
+        assert build(s).collect()[0][0] == "C:\\Users b"
+
+    def test_split_part_zero_raises(self):
+        from spark_rapids_tpu.exprs import SplitPart
+        with pytest.raises(ValueError):
+            SplitPart(ColumnRef("s"), ",", 0)

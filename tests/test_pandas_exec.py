@@ -155,3 +155,51 @@ def test_window_in_pandas_validates_inputs():
                                               "v")})
     with _pytest.raises(ValueError):
         df.window_in_pandas(["k"], {"v": (lambda s_: 0.0, T.DOUBLE, "v")})
+
+
+def test_worker_slot_does_not_leak_device_permits():
+    """A thread that holds NO device permit must not end up donating one
+    (TpuSemaphore.release at depth 0 is a no-op, so blind re-acquire would
+    leak admission and deadlock later queries)."""
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.plan.physical import ExecContext
+    from spark_rapids_tpu.runtime.device import TpuSemaphore
+    from spark_rapids_tpu.runtime.python_worker import python_worker_slot
+
+    sem = TpuSemaphore(1)
+    ctx = ExecContext(RapidsConf(), semaphore=sem)
+    with python_worker_slot(ctx):
+        pass
+    assert sem.held_depth() == 0
+    assert sem._sem.acquire(blocking=False)  # permit still available
+    sem._sem.release()
+    # and a holder releases + re-acquires cleanly
+    sem.acquire()
+    with python_worker_slot(ctx):
+        assert sem.held_depth() == 0  # released while python runs
+    assert sem.held_depth() == 1
+    sem.release()
+
+
+def test_cogroup_null_keys_pair_up():
+    s = tpu_session()
+    left = s.create_dataframe({"k": ["a", None], "v": [1, 2]})
+    right = s.create_dataframe({"k": [None, "b"], "w": [10, 20]})
+
+    def fn(lg, rg):
+        import pandas as pd
+        key = None
+        if len(lg):
+            key = lg["k"].iloc[0]
+        elif len(rg):
+            key = rg["k"].iloc[0]
+        if key is not None and key != key:
+            key = None
+        return pd.DataFrame({
+            "k": [key], "ln": [len(lg)], "rn": [len(rg)]})
+
+    out = left.group_by("k").cogroup(right.group_by("k")).apply_in_pandas(
+        fn, [("k", T.STRING), ("ln", T.LONG), ("rn", T.LONG)])
+    rows = {r[0]: (r[1], r[2]) for r in out.collect()}
+    # the NULL key must appear ONCE with both sides
+    assert rows[None] == (1, 1), rows
