@@ -171,6 +171,21 @@ def run_distributed_query_demo(n_devices: int, n_rows: int = 4000) -> dict:
     assert mesh_ops, \
         f"no exchange took the mesh path; metrics={tpu.last_metrics}"
 
+    # and a SHUFFLED JOIN through the same collective (both sides
+    # all-to-all'd by key over the mesh, broadcast planning disabled)
+    tpu.conf.set("spark.sql.autoBroadcastJoinThreshold", -1)
+    dim = tpu.create_dataframe(
+        {"cat": [c for c in cats if c is not None],
+         "bonus": list(range(len(cats) - 1))}, num_partitions=2)
+    fact = tpu.create_dataframe(
+        {"cat": list(cat), "qty": qty.tolist()}, num_partitions=4)
+    joined = fact.join(dim, on="cat", how="left")
+    jrows = joined.collect()
+    assert len(jrows) == n_rows, (len(jrows), n_rows)
+    join_mesh_ops = [op for op, ms in tpu.last_metrics.items()
+                     if ms.get("meshExchanges")]
+    assert len(join_mesh_ops) >= 2, tpu.last_metrics  # both join sides
+
     # oracle: plain python
     expect = {}
     for c, q, p in zip(cat, qty, price):

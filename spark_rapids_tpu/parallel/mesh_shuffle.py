@@ -359,6 +359,15 @@ def mesh_exchange_batches(mesh: Mesh, local_batches, pids_list,
     fn = _cached_payload_exchange_fn(mesh, tuple(ndims), cap)
     out_payloads, counts = fn(payloads, d_rows, d_pids)
 
+    # Materialize per-device LOCAL batches: slicing the mesh-sharded
+    # globals lazily would make every downstream per-partition program a
+    # hidden cross-device collective — interleaved consumers (join sides,
+    # AQE groups) then deadlock the rendezvous.  One staged host hop keeps
+    # all post-shuffle work strictly local, like the reference's receive
+    # side landing bounce buffers into device-local batches.
+    host_payloads = jax.device_get(list(out_payloads))
+    counts_h = np.asarray(jax.device_get(counts))
+
     out_cap = n * cap
     out: List[ColumnBatch] = []
     for d in range(n):
@@ -370,15 +379,19 @@ def mesh_exchange_batches(mesh: Mesh, local_batches, pids_list,
                 byte_cap = round_up_capacity(max(out_cap * ml, 16),
                                              minimum=16)
                 data, offsets = _padded_to_flat(
-                    out_payloads[slot][d], out_payloads[slot + 1][d],
+                    jnp.asarray(host_payloads[slot][d]),
+                    jnp.asarray(host_payloads[slot + 1][d]),
                     byte_cap)
-                cols.append(DeviceColumn(f.dtype, data,
-                                         out_payloads[slot + 2][d],
-                                         offsets))
+                cols.append(DeviceColumn(
+                    f.dtype, data,
+                    jnp.asarray(host_payloads[slot + 2][d]), offsets))
                 slot += 3
             else:
-                cols.append(DeviceColumn(f.dtype, out_payloads[slot][d],
-                                         out_payloads[slot + 1][d], None))
+                cols.append(DeviceColumn(
+                    f.dtype, jnp.asarray(host_payloads[slot][d]),
+                    jnp.asarray(host_payloads[slot + 1][d]), None))
                 slot += 2
-        out.append(ColumnBatch(schema, cols, counts[d], out_cap))
+        out.append(ColumnBatch(schema, cols,
+                               jnp.asarray(int(counts_h[d]), jnp.int32),
+                               out_cap))
     return out
