@@ -38,7 +38,8 @@ def group_segments(key_vals: List[DevVal], num_rows) -> GroupSegments:
     """Sort rows by key and mark exact group boundaries."""
     cap = int(key_vals[0].validity.shape[0])
     perm = argsort_batch(key_vals, [True] * len(key_vals),
-                         [True] * len(key_vals), num_rows)
+                         [True] * len(key_vals), num_rows,
+                         groupings=[True] * len(key_vals))
     live = jnp.arange(cap, dtype=jnp.int32) < num_rows
     # Reorder key columns by the permutation; strings need real byte gathers
     # for the adjacent-equality check (cheap relative to the sort itself).

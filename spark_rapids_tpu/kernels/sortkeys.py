@@ -161,16 +161,32 @@ def string_prefix_words(col_or_val, prefix_bytes: int) -> List[jnp.ndarray]:
 
 def encode_sort_keys(vals: List[DevVal], ascendings: List[bool],
                      nulls_firsts: List[bool], num_rows,
-                     string_prefix_bytes: int = DEFAULT_STRING_PREFIX_BYTES
-                     ) -> List[jnp.ndarray]:
+                     string_prefix_bytes: int = DEFAULT_STRING_PREFIX_BYTES,
+                     groupings: Optional[List[bool]] = None,
+                     liveness: bool = True) -> List[jnp.ndarray]:
     """Full u32 key-word list for a multi-column sort.
 
-    Word 0 forces padding rows (row >= num_rows) to the end; each key column
-    contributes a null-rank word then its value word(s)."""
+    With ``liveness`` (the default), a leading word forces padding rows
+    (row >= num_rows) to the end; each key column contributes a null-rank
+    word then its value word(s).  The liveness bit is folded into the first
+    null-rank word (both are un-negated 1-bit ranks) to save a sort pass.
+
+    ``groupings[i]`` marks key i as *grouping-only*: the caller needs equal
+    keys adjacent (groupby segmentation, window partitioning) but does not
+    care about the order *between* distinct keys.  String columns then
+    encode as (length, h1, h2) — 3 words instead of prefix_bytes/4 + 3 —
+    which cuts the sort-operand count that drives TPU compile time.  Equal
+    strings still always land adjacent; the only risk is a dual-32-bit-hash
+    + length collision between *distinct* strings that interleave, the same
+    collision class as the documented string join equality."""
     cap = int(vals[0].validity.shape[0]) if vals else 0
-    live = jnp.arange(cap, dtype=jnp.int32) < num_rows
-    words: List[jnp.ndarray] = [jnp.where(live, 0, 1).astype(jnp.uint32)]
-    for v, asc, nf in zip(vals, ascendings, nulls_firsts):
+    words: List[jnp.ndarray] = []
+    if liveness:
+        live = jnp.arange(cap, dtype=jnp.int32) < num_rows
+        words.append(jnp.where(live, 0, 1).astype(jnp.uint32))
+    if groupings is None:
+        groupings = [False] * len(vals)
+    for v, asc, nf, grp in zip(vals, ascendings, nulls_firsts, groupings):
         null_rank = jnp.where(v.validity, 1, 0) if nf else \
             jnp.where(v.validity, 0, 1)
         words.append(null_rank.astype(jnp.uint32))
@@ -183,25 +199,57 @@ def encode_sort_keys(vals: List[DevVal], ascendings: List[bool],
             # prefix-equal strings.  Beyond-prefix *order* between unequal
             # strings remains approximate (documented).
             from spark_rapids_tpu.exprs.strings import string_hash2
-            vwords = string_prefix_words(v, string_prefix_bytes)
             lens = (v.offsets[1:] - v.offsets[:-1]).astype(jnp.uint32)
             h1, h2 = string_hash2(v)
-            vwords = vwords + [lens, h1.astype(jnp.uint32),
-                               h2.astype(jnp.uint32)]
+            tail = [lens, h1.astype(jnp.uint32), h2.astype(jnp.uint32)]
+            if grp:
+                vwords = tail
+            else:
+                vwords = string_prefix_words(v, string_prefix_bytes) + tail
         else:
             vwords = _encode_fixed_words(v)
         for w in vwords:
             w = jnp.where(v.validity, w, 0)  # nulls all compare equal
             words.append(w if asc else ~w)
+    if liveness and len(words) >= 2:
+        # Fold: (pad << 1) | null_rank_of_first_key.  Neither word is ever
+        # negated for descending order, so the fold preserves the ordering.
+        words = [(words[0] << jnp.uint32(1)) | words[1]] + words[2:]
     return words
+
+
+# lax.sort compile time on this TPU toolchain grows ~2x per added operand
+# (measured round 4: 8.6s / 17s / 67s / 171s cold for 1 / 2 / 3 / 5 key
+# words at 64K-4M rows), so a 20-word string sort never finishes compiling.
+# A least-significant-word-first chain of identical 2-operand stable sorts
+# compiles once and stays flat (~20-35s for 20 passes) at <2x the direct
+# sort's runtime — so on TPU any multi-word sort takes the LSD path.
+_DIRECT_SORT_MAX_WORDS_TPU = 1
 
 
 def argsort_by_words(words: List[jnp.ndarray], cap: int) -> jnp.ndarray:
     """Stable permutation (int32[cap]) ordering rows by the word tuple."""
     iota = jnp.arange(cap, dtype=jnp.int32)
+    if not words:
+        return iota
+    if jax.default_backend() == "tpu" and \
+            len(words) > _DIRECT_SORT_MAX_WORDS_TPU:
+        return _argsort_lsd(words, iota)
     out = jax.lax.sort(tuple(words) + (iota,), num_keys=len(words),
                        is_stable=True)
     return out[-1]
+
+
+def _argsort_lsd(words: List[jnp.ndarray], perm: jnp.ndarray) -> jnp.ndarray:
+    """LSD radix argsort: stable-sort by each word, least significant first.
+
+    After processing word i, rows are stably ordered by words[i:]; the final
+    permutation therefore orders by the full lexicographic word tuple —
+    identical to the direct multi-operand sort (cross-checked in
+    tests/test_kernels_sort.py)."""
+    for w in reversed(words):
+        _, perm = jax.lax.sort((w[perm], perm), num_keys=1, is_stable=True)
+    return perm
 
 
 def keys_equal_prev(vals: List[DevVal]) -> jnp.ndarray:

@@ -221,7 +221,9 @@ class TpuWindowExec(TpuExec):
         ascs = [True] * len(pkeys) + [o.ascending for o in self.order_by]
         nfs = [True] * len(pkeys) + [o.nulls_first for o in self.order_by]
         if all_vals:
-            perm = argsort_batch(all_vals, ascs, nfs, batch.num_rows)
+            groupings = [True] * len(pkeys) + [False] * len(okeys)
+            perm = argsort_batch(all_vals, ascs, nfs, batch.num_rows,
+                                 groupings=groupings)
         else:
             perm = jnp.arange(cap, dtype=jnp.int32)
         sorted_batch = gather_rows(batch, perm, batch.num_rows)

@@ -147,8 +147,9 @@ class RangePartitioning(Partitioning):
                 for i in self.key_ordinals]
         ascs = [o.ascending for o in self.orders]
         nfs = [o.nulls_first for o in self.orders]
-        words = encode_sort_keys(vals, ascs, nfs, batch.num_rows)[1:]
-        # words[0] (liveness) dropped: padding rows' pid is masked later.
+        words = encode_sort_keys(vals, ascs, nfs, batch.num_rows,
+                                 liveness=False)
+        # No liveness word: padding rows' pid is masked later.
         pid = jnp.zeros(cap, dtype=jnp.int32)
         for bound in self.bound_rows:
             bwords = self._encode_bound(bound)
@@ -179,5 +180,6 @@ class RangePartitioning(Partitioning):
         vals = [DevVal.from_column(c) for c in db.columns]
         ascs = [o.ascending for o in self.orders]
         nfs = [o.nulls_first for o in self.orders]
-        words = encode_sort_keys(vals, ascs, nfs, db.num_rows)[1:]
+        words = encode_sort_keys(vals, ascs, nfs, db.num_rows,
+                                 liveness=False)
         return [w[0] for w in words]

@@ -1,0 +1,151 @@
+"""Sort-key encoding and LSD argsort tests.
+
+The TPU backend routes every multi-word sort through `_argsort_lsd`
+(sortkeys.py) because lax.sort compile time grows ~2x per operand on the
+TPU toolchain.  These tests cross-check the LSD chain against the direct
+multi-operand sort on CPU, and pin down the grouping-mode string encoding
+plus the liveness/null-rank word fold.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.batch import HostBatch, device_to_host, host_to_device
+from spark_rapids_tpu.exprs.base import DevVal
+from spark_rapids_tpu.kernels.sort import argsort_batch, sort_batch
+from spark_rapids_tpu.kernels.sortkeys import (
+    _argsort_lsd,
+    encode_sort_keys,
+    keys_equal_prev,
+)
+
+
+@pytest.mark.parametrize("n_words", [1, 2, 3, 5, 8, 21])
+def test_lsd_matches_direct_sort(n_words):
+    rng = np.random.default_rng(42 + n_words)
+    cap = 512
+    # Tiny alphabet => lots of ties, so a stability bug would show.
+    words = [jnp.asarray(rng.integers(0, 4, size=cap, dtype=np.uint32))
+             for _ in range(n_words)]
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    direct = jax.lax.sort(tuple(words) + (iota,), num_keys=n_words,
+                          is_stable=True)[-1]
+    lsd = _argsort_lsd(words, iota)
+    np.testing.assert_array_equal(np.asarray(direct), np.asarray(lsd))
+
+
+def test_lsd_under_jit_matches():
+    rng = np.random.default_rng(7)
+    cap = 256
+    words = [jnp.asarray(rng.integers(0, 1 << 32, size=cap, dtype=np.uint32))
+             for _ in range(6)]
+    iota = jnp.arange(cap, dtype=jnp.int32)
+
+    direct = jax.lax.sort(tuple(words) + (iota,), num_keys=6,
+                          is_stable=True)[-1]
+    lsd = jax.jit(lambda ws: _argsort_lsd(list(ws), iota))(tuple(words))
+    np.testing.assert_array_equal(np.asarray(direct), np.asarray(lsd))
+
+
+def _str_val(values):
+    hb = HostBatch.from_pydict({"s": (T.STRING, values)})
+    db = host_to_device(hb)
+    return DevVal.from_column(db.columns[0]), db
+
+
+def _int_val(values, dtype=T.INT):
+    hb = HostBatch.from_pydict({"x": (dtype, values)})
+    db = host_to_device(hb)
+    return DevVal.from_column(db.columns[0]), db
+
+
+def test_grouping_mode_equal_strings_adjacent():
+    vals = ["pear", "apple", "pear", "fig", "apple", "pear", None, "fig",
+            None, "apple"] * 7
+    v, db = _str_val(vals)
+    perm = argsort_batch([v], [True], [True], db.num_rows, groupings=[True])
+    # Grouping encoding: every run of equal values must be contiguous.
+    g = device_to_host(db).to_pydict()["s"]
+    n = int(db.num_rows)
+    sorted_vals = [g[int(i)] for i in np.asarray(perm)[:n]]
+    seen = set()
+    prev = object()
+    for s in sorted_vals:
+        if s != prev:
+            assert s not in seen, f"group {s!r} split across the sort"
+            seen.add(s)
+            prev = s
+    assert seen == {None, "apple", "fig", "pear"}
+
+
+def test_grouping_vs_full_encode_same_groups():
+    vals = ["aa", "ab", "aa", None, "b", "ab", "aa", None]
+    v, db = _str_val(vals)
+    for groupings in (None, [True]):
+        perm = argsort_batch([v], [True], [True], db.num_rows,
+                             groupings=groupings)
+        n = int(db.num_rows)
+        g = device_to_host(db).to_pydict()["s"]
+        sorted_vals = [g[int(i)] for i in np.asarray(perm)[:n]]
+        from collections import Counter
+        assert Counter(map(repr, sorted_vals)) == \
+            Counter(map(repr, vals))
+
+
+def test_liveness_fold_padding_rows_last():
+    # Pad capacity beyond num_rows; padding must sort after every live row,
+    # including nulls-last live rows.
+    hb = HostBatch.from_pydict({"x": (T.INT, [3, None, 1, 2])})
+    db = host_to_device(hb, capacity=16)
+    v = DevVal.from_column(db.columns[0])
+    for nf in (True, False):
+        words = encode_sort_keys([v], [True], [nf], db.num_rows)
+        perm = np.asarray(_argsort_lsd(words,
+                                       jnp.arange(16, dtype=jnp.int32)))
+        live_positions = [int(np.where(perm == i)[0][0]) for i in range(4)]
+        assert max(live_positions) <= 3, \
+            f"padding sorted before live rows (nulls_first={nf})"
+        order = [int(i) for i in perm[:4]]
+        vals = [3, None, 1, 2]
+        got = [vals[i] for i in order]
+        assert got == ([None, 1, 2, 3] if nf else [1, 2, 3, None])
+
+
+def test_fold_collapses_liveness_word():
+    v, db = _int_val([5, 1, 4])
+    words_folded = encode_sort_keys([v], [True], [True], db.num_rows)
+    words_sep = encode_sort_keys([v], [True], [True], db.num_rows,
+                                 liveness=False)
+    assert len(words_folded) == len(words_sep)  # fold saved one word
+
+
+def test_string_order_by_still_lexicographic():
+    vals = ["banana", "apple", "cherry", "apricot", None, "b"]
+    v, db = _str_val(vals)
+    out = device_to_host(
+        sort_batch(db, [v], [True], [True])).to_pydict()["s"]
+    assert out == [None, "apple", "apricot", "b", "banana", "cherry"]
+
+
+def test_multi_key_mixed_grouping():
+    # Grouping string key + full-order int key: within each string group,
+    # ints must be exactly ordered.
+    ks = ["x", "y", "x", "y", "x", "y", "x"]
+    xs = [5, 2, 1, 9, 3, 0, 4]
+    hb = HostBatch.from_pydict({"k": (T.STRING, ks), "x": (T.INT, xs)})
+    db = host_to_device(hb)
+    kv = DevVal.from_column(db.columns[0])
+    xv = DevVal.from_column(db.columns[1])
+    perm = argsort_batch([kv, xv], [True, True], [True, True], db.num_rows,
+                         groupings=[True, False])
+    n = int(db.num_rows)
+    order = [int(i) for i in np.asarray(perm)[:n]]
+    got = [(ks[i], xs[i]) for i in order]
+    by_group = {}
+    for k, x in got:
+        by_group.setdefault(k, []).append(x)
+    assert by_group["x"] == sorted(by_group["x"])
+    assert by_group["y"] == sorted(by_group["y"])
