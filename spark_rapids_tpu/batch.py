@@ -392,6 +392,27 @@ def host_sizes(batches: Sequence[ColumnBatch]) -> List[Tuple[int, List[int]]]:
     return [(int(n), [int(t) for t in totals]) for n, totals in host]
 
 
+def colocate_batches(batches: Sequence[ColumnBatch]
+                     ) -> Sequence[ColumnBatch]:
+    """Move batches onto one device when they span several.
+
+    After a device-resident mesh shuffle, each partition's batch lives on
+    its own mesh device; a stage that merges several partitions into one
+    program (global sort, final collect, broadcast build) must first gather
+    them — a device-to-device transfer, never through the host.  No-op in
+    the common single-device case."""
+    devs = set()
+    for b in batches:
+        for leaf in jax.tree_util.tree_leaves(b):
+            get_devs = getattr(leaf, "devices", None)
+            if callable(get_devs):
+                devs.update(get_devs())
+    if len(devs) <= 1:
+        return batches
+    target = sorted(devs, key=lambda d: d.id)[0]
+    return jax.device_put(list(batches), target)
+
+
 def empty_device_batch(schema: T.Schema, capacity: int = MIN_CAPACITY) -> ColumnBatch:
     cols = []
     for f in schema.fields:

@@ -685,8 +685,103 @@ class GroupedData:
                     e, name or f"{e.name.lower()}_{i}"))
             else:
                 raise TypeError(f"not an aggregate: {a!r}")
+        from spark_rapids_tpu.exprs import aggregates as A
+        if any(isinstance(a.fn, A.CountDistinct) for a in out):
+            return self._agg_with_distinct(out)
         node = L.Aggregate(self.keys, self.names, out, self.df.plan)
         return DataFrame(node, self.df.session)
+
+    def _agg_with_distinct(self, out: List[AggregateExpression]
+                           ) -> DataFrame:
+        """The distinct-aggregate rewrite (Spark RewriteDistinctAggregates):
+
+            Agg(k, [count(DISTINCT v), regular...])
+              -> Agg(k, [count(v'), re-agg(regular)],
+                     Agg(k + [v], [regular per (k, v)]))
+
+        The inner aggregate dedups (k, v) pairs while computing the regular
+        aggregates once per pair; the outer counts the now-unique non-null
+        values and re-aggregates the regulars (sum of sums, sum of counts,
+        min of mins, avg from sum/count).  Both levels ride the existing
+        partial/merge exchange machinery unchanged."""
+        from spark_rapids_tpu.exprs import aggregates as A
+        from spark_rapids_tpu.exprs.arithmetic import Divide
+        from spark_rapids_tpu.exprs.cast import Cast
+
+        dvals = [a.fn.child for a in out if isinstance(a.fn, A.CountDistinct)]
+        if any(repr(e) != repr(dvals[0]) for e in dvals[1:]):
+            raise NotImplementedError(
+                "count_distinct over different expressions in one "
+                "aggregation needs the Expand-based rewrite (not yet "
+                "implemented); split into separate aggregations")
+        dname = "__cd_val"
+
+        inner_aggs: List[AggregateExpression] = []
+        plans = []  # one entry per output: how the outer level produces it
+        for i, a in enumerate(out):
+            fn = a.fn
+            if isinstance(fn, A.CountDistinct):
+                plans.append(("count_distinct",))
+            elif isinstance(fn, A.Average):
+                ns, nc = f"__cd_s{i}", f"__cd_c{i}"
+                inner_aggs.append(A.AggregateExpression(A.Sum(fn.child), ns))
+                inner_aggs.append(A.AggregateExpression(A.Count(fn.child),
+                                                        nc))
+                plans.append(("avg", ns, nc))
+            elif isinstance(fn, (A.Sum, A.Count, A.Min, A.Max, A.First,
+                                 A.Last)):
+                nm = f"__cd_a{i}"
+                inner_aggs.append(A.AggregateExpression(fn, nm))
+                plans.append(("reagg", nm, fn))
+            else:
+                raise NotImplementedError(
+                    f"{type(fn).__name__} cannot be combined with "
+                    f"count_distinct (no re-aggregation rule)")
+
+        inner = GroupedData(self.df, self.keys + [dvals[0]],
+                            self.names + [dname]).agg(*inner_aggs)
+
+        outer_gd = inner.group_by(*self.names)
+        o_aggs: List[AggregateExpression] = []
+        avg_slots = {}  # output index -> (sum_name, count_name)
+        for i, (a, plan) in enumerate(zip(out, plans)):
+            if plan[0] == "count_distinct":
+                o_aggs.append(A.AggregateExpression(
+                    A.Count(inner._resolve(ColumnRef(dname))),
+                    a.output_name))
+            elif plan[0] == "avg":
+                _, ns, nc = plan
+                os_, oc = f"__cd_os{i}", f"__cd_oc{i}"
+                o_aggs.append(A.AggregateExpression(
+                    A.Sum(inner._resolve(ColumnRef(ns))), os_))
+                o_aggs.append(A.AggregateExpression(
+                    A.Sum(inner._resolve(ColumnRef(nc))), oc))
+                avg_slots[i] = (os_, oc)
+            else:
+                _, nm, fn = plan
+                ref = inner._resolve(ColumnRef(nm))
+                if isinstance(fn, A.Count):
+                    o_fn = A.Sum(ref)  # sum of per-(k,v) counts
+                elif isinstance(fn, (A.First, A.Last)):
+                    o_fn = type(fn)(ref, fn.ignore_nulls)
+                else:
+                    o_fn = type(fn)(ref)
+                o_aggs.append(A.AggregateExpression(o_fn, a.output_name))
+        outer = outer_gd.agg(*o_aggs)
+
+        if not avg_slots:
+            return outer
+        # Rebuild avg outputs as sum/count and restore column order/names.
+        sel: List[Column] = [Column(ColumnRef(n)) for n in self.names]
+        for i, a in enumerate(out):
+            if i in avg_slots:
+                os_, oc = avg_slots[i]
+                e = Divide(Cast(ColumnRef(os_), T.DOUBLE),
+                           Cast(ColumnRef(oc), T.DOUBLE))
+                sel.append(Column(Alias(e, a.output_name)))
+            else:
+                sel.append(Column(ColumnRef(a.output_name)))
+        return outer.select(*sel)
 
     def count(self) -> DataFrame:
         return self.agg(Column(Alias(count_star(), "count")))

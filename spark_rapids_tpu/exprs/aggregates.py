@@ -352,6 +352,28 @@ class Last(_FirstLast):
     _is_first = False
 
 
+class CountDistinct(AggregateFunction):
+    """count(DISTINCT x).
+
+    Never executed directly: the dataframe layer rewrites any aggregation
+    containing it into two stacked Aggregates (group by keys+value, then by
+    keys), the distinct-aggregate rewrite Spark's planner applies
+    (cf. RewriteDistinctAggregates; the reference rides the rewritten plan's
+    Partial/PartialMerge modes, aggregate.scala).  See
+    GroupedData._agg_with_distinct."""
+
+    def _resolve_type(self):
+        self.dtype = T.LONG
+        self.nullable = False
+
+    def tpu_supported(self, conf):
+        return None
+
+    def buffers(self):
+        raise AssertionError(
+            "CountDistinct must be rewritten before execution")
+
+
 @dataclasses.dataclass
 class AggregateExpression:
     """An aggregate call in an output position: fn + output name."""

@@ -65,9 +65,12 @@ def last(c, ignore_nulls: bool = False) -> Column:
 
 
 def count_distinct(c) -> Column:
-    raise NotImplementedError(
-        "countDistinct lowers to distinct+count; use "
-        "df.select(c).distinct().count()")
+    """count(DISTINCT c) — rewritten by the dataframe layer into the
+    two-level distinct-aggregate plan (GroupedData._agg_with_distinct)."""
+    return _agg(A.CountDistinct, c)
+
+
+countDistinct = count_distinct
 
 
 # -- scalar functions --------------------------------------------------------

@@ -85,7 +85,8 @@ def _concat_all(batches: List[ColumnBatch], schema: T.Schema,
         return None
     if len(batches) == 1:
         return batches[0]
-    from spark_rapids_tpu.batch import host_sizes
+    from spark_rapids_tpu.batch import colocate_batches, host_sizes
+    batches = list(colocate_batches(batches))
     if sizes is None:
         sizes = host_sizes(batches)
     total_rows = sum(n for n, _ in sizes)

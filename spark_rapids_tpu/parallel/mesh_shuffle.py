@@ -9,12 +9,13 @@ and is scheduled by XLA, no progress thread / bounce buffers needed.
 
 Layout contract: a *mesh batch* is a pytree of arrays whose leading axis is
 the mesh's ``data`` axis (one slice per device): data[N, cap], validity
-[N, cap], num_rows[N].  Strings ride the same collective as fixed-width
-columns by flattening each device's (offsets, bytes) pair into a padded
-``uint8[cap, maxlen]`` row matrix + ``int32[cap]`` lengths before the
-all-to-all, and rebuilding the offsets layout on the receive side — the
-TPU answer to the reference's bounce-buffer framing of varlen buffers
-(RapidsShuffleServer.scala:343-612).
+[N, cap], num_rows[N].  Varlen columns (strings, arrays) ride the same
+collective as fixed-width columns: each device's flat element buffer is
+re-bucketed by destination inside the SPMD program and moves as one
+``[N, ecap]`` stream with per-bucket element counts, the offsets layout
+rebuilt on the receive side — the TPU answer to the reference's
+bounce-buffer framing of varlen buffers
+(RapidsShuffleServer.scala:343-612), with no host staging on either side.
 
 :func:`mesh_exchange_batches` is the engine-facing entry: it is what
 ``TpuShuffleExchangeExec`` calls when a >1-device mesh is active
@@ -24,7 +25,6 @@ plan's shuffle rather than a standalone demo.
 
 from __future__ import annotations
 
-import functools
 from typing import List, Optional, Tuple
 
 import jax
@@ -154,110 +154,241 @@ def make_exchange_fn(mesh: Mesh, n_cols: int, cap: int):
 
 
 # --------------------------------------------------------------------------
-# Engine-facing batch exchange (strings included)
+# Engine-facing batch exchange (strings/arrays included), device-resident
 # --------------------------------------------------------------------------
 #
-# A ColumnBatch is lowered to a flat list of *payload* arrays, each with the
-# row index as the leading axis:
-#   fixed col   -> data[cap], validity[cap]
-#   string col  -> bytes uint8[cap, maxlen], lengths int32[cap],
-#                  validity[cap]
-# One shard_map program buckets rows by destination device, runs ONE
-# lax.all_to_all per payload over ICI, and compacts the n received buckets
-# into a single local batch of capacity n*cap.  Row-major payloads mean the
-# string bytes move on the same collective as the data — no separate varlen
-# protocol.
+# Shuffle payloads never visit the host.  The path is:
+#
+#   1. pack:    a per-device jitted pad-to-common-capacity of each local
+#               batch's raw buffers (data, validity, offsets, pids), run on
+#               the target mesh device after a device-to-device placement.
+#   2. gather:  ``jax.make_array_from_single_device_arrays`` stitches the n
+#               per-device shards into mesh-sharded globals — metadata only,
+#               no copies.
+#   3. exchange: ONE shard_map program buckets rows by destination device,
+#               streams each varlen column's element buffer as a flat
+#               per-bucket run (searchsorted over cumulative lengths — no
+#               padded row matrix, so one long string no longer inflates
+#               every row's slot), runs one lax.all_to_all per payload over
+#               ICI, and compacts the n received buckets into a device-local
+#               batch.
+#   4. unshard: each output global's addressable shard *is* the per-device
+#               result; one jitted squeeze per device yields plain
+#               single-device arrays, so downstream per-partition programs
+#               stay strictly local (no hidden collectives, no rendezvous
+#               hazard between interleaved consumers).
+#
+# This is the TPU answer to the reference's device-resident shuffle: map
+# output batches stay in the device store
+# (RapidsShuffleInternalManager.scala:91-154) and receives land directly in
+# device buffers (RapidsShuffleClient.scala:108-355); here both legs are a
+# single XLA-scheduled collective.  tests/test_mesh_shuffle.py asserts that
+# no payload-sized jax.device_get happens between map eval and consumption.
 
 
-def make_payload_exchange_fn(mesh: Mesh, ndims: Tuple[int, ...], cap: int):
-    """Build the jitted SPMD exchange over arbitrary row-payload arrays.
+def _fit_1d(x, out_len: int):
+    """Pad with zeros or truncate to ``out_len``.
 
-    ``ndims[i]`` is the per-device rank of payload i (1 for [cap] vectors,
-    2 for [cap, maxlen] byte matrices).  The returned fn maps
-    (payloads [N, cap, ...], num_rows [N], pids [N, cap]) ->
-    (payloads [N, N*cap, ...], counts [N]).
+    Truncation is safe because callers size out_len from live row / element
+    counts (host_sizes): everything past them is padding."""
+    in_len = int(x.shape[0])
+    if in_len == out_len:
+        return x
+    if in_len > out_len:
+        return x[:out_len]
+    pad = jnp.zeros((out_len - in_len,), dtype=x.dtype)
+    return jnp.concatenate([x, pad])
+
+
+def _make_pack_fn(schema, cap: int, ecaps: dict):
+    """Jitted per-device pack: fit every buffer of (columns, num_rows, pids)
+    to the common capacities and add a leading shard axis of size 1."""
+
+    def pack(columns, num_rows, pids):
+        payloads = []
+        for ci, f in enumerate(schema.fields):
+            c = columns[ci]
+            if c.offsets is not None:
+                ecap = ecaps[ci]
+                data = _fit_1d(c.data, ecap)
+                # fit offsets: padded rows repeat the end offset
+                # (zero-length); truncation keeps all live rows' offsets
+                offs = c.offsets
+                if int(offs.shape[0]) > cap + 1:
+                    offs = offs[:cap + 1]
+                elif int(offs.shape[0]) < cap + 1:
+                    tail = jnp.full((cap + 1 - int(offs.shape[0]),),
+                                    0, dtype=offs.dtype) + offs[-1]
+                    offs = jnp.concatenate([offs, tail])
+                payloads += [data[None], offs.astype(jnp.int32)[None],
+                             _fit_1d(c.validity, cap)[None]]
+            else:
+                payloads += [_fit_1d(c.data, cap)[None],
+                             _fit_1d(c.validity, cap)[None]]
+        payloads.append(_fit_1d(pids.astype(jnp.int32), cap)[None])
+        payloads.append(jnp.asarray(num_rows, jnp.int32).reshape(1))
+        return payloads
+
+    return jax.jit(pack)
+
+
+@jax.jit
+def _unshard(arrs):
+    """Drop the leading shard axis of each per-device output shard — one
+    dispatch per device, on that device."""
+    return [a[0] for a in arrs]
+
+
+def _make_mesh_payload_fn(mesh: Mesh, sig, cap: int, ecaps: tuple,
+                          out_cap: int, out_ecaps: tuple):
+    """The SPMD exchange program over one batch schema shape.
+
+    ``sig[i]`` is True for varlen columns.  Payload order per column:
+    varlen -> (elements[ecap], offsets[cap+1], validity[cap]);
+    fixed  -> (data[cap], validity[cap]); then pids[cap], num_rows[1].
     """
     n = mesh.shape[DATA_AXIS]
 
-    def spmd(payloads, num_rows, pids):
-        pls = [p[0] for p in payloads]
-        nr = num_rows[0]
-        pid = pids[0]
+    def a2a(x):
+        return jax.lax.all_to_all(x, DATA_AXIS, 0, 0, tiled=False)
+
+    def spmd(payloads):
+        pls = [p[0] for p in payloads[:-1]]
+        nr = payloads[-1][0]
+        pid = pls[-1]
+        cols = pls[:-1]
+
         live = jnp.arange(cap, dtype=jnp.int32) < nr
         pid = jnp.where(live, pid, n)  # padding rows -> dead bucket
         order = jnp.argsort(pid, stable=True).astype(jnp.int32)
         sorted_pid = pid[order]
         counts = jnp.zeros(n + 1, jnp.int32).at[sorted_pid].add(
             1, mode="drop")[:n]
-        starts = jnp.concatenate([
-            jnp.zeros(1, jnp.int32),
-            jnp.cumsum(counts).astype(jnp.int32)[:-1]])
+        starts = jnp.cumsum(counts) - counts
         j_idx = jnp.arange(cap, dtype=jnp.int32)[None, :]
         src = jnp.clip(starts[:, None] + j_idx, 0, cap - 1)
         in_bucket = j_idx < counts[:, None]
         rows = order[src]  # [n, cap] source row per (dest bucket, slot)
-        bucketed = []
-        for p in pls:
-            g = p[rows]  # [n, cap, ...trailing]
-            mask = in_bucket.reshape(in_bucket.shape +
-                                     (1,) * (g.ndim - 2))
-            bucketed.append(jnp.where(mask, g, jnp.zeros((), g.dtype)))
-        recv = [jax.lax.all_to_all(b, DATA_AXIS, 0, 0, tiled=False)
-                for b in bucketed]
-        r_counts = jax.lax.all_to_all(counts, DATA_AXIS, 0, 0, tiled=False)
-        # compact the n received buckets into one local run of rows
-        out_cap = n * cap
-        flat = jnp.arange(out_cap, dtype=jnp.int32)
+
+        send = []          # bucketed payloads, one list entry per wire array
+        recv_plan = []     # (kind, ...) mirror for the receive side
+        slot = 0
+        for vi, is_varlen in enumerate(sig):
+            if is_varlen:
+                data, offs, valid = cols[slot], cols[slot + 1], cols[slot + 2]
+                ecap = ecaps[vi]
+                lens = jnp.where(live, offs[1:] - offs[:-1], 0) \
+                    .astype(jnp.int32)
+                slens = lens[order]
+                scum = jnp.cumsum(slens).astype(jnp.int32)
+                sexcl = scum - slens
+                ecounts = jnp.zeros(n + 1, jnp.int32).at[sorted_pid].add(
+                    slens, mode="drop")[:n]
+                estarts = jnp.cumsum(ecounts) - ecounts
+                k = jnp.arange(ecap, dtype=jnp.int32)[None, :]
+                pos = estarts[:, None] + k          # [n, ecap]
+                r = jnp.clip(jnp.searchsorted(
+                    scum, pos, side="right").astype(jnp.int32), 0, cap - 1)
+                src_e = offs[order[r]] + (pos - sexcl[r])
+                elem = data[jnp.clip(src_e, 0, ecap - 1)]
+                elem = jnp.where(k < ecounts[:, None], elem,
+                                 jnp.zeros((), data.dtype))
+                blens = jnp.where(in_bucket, lens[rows], 0)
+                bvalid = jnp.where(in_bucket, valid[rows], False)
+                send += [elem, blens, bvalid, ecounts]
+                recv_plan.append(("varlen", vi))
+                slot += 3
+            else:
+                data, valid = cols[slot], cols[slot + 1]
+                bdata = jnp.where(in_bucket, data[rows],
+                                  jnp.zeros((), data.dtype))
+                bvalid = jnp.where(in_bucket, valid[rows], False)
+                send += [bdata, bvalid]
+                recv_plan.append(("fixed", vi))
+                slot += 2
+
+        wire = [a2a(x) for x in send] + [a2a(counts)]
+        r_counts = wire[-1]
+
+        # receive-side row compaction indices, shared by all columns
+        total = jnp.sum(r_counts).astype(jnp.int32)
         cum = jnp.cumsum(r_counts)
         starts2 = cum - r_counts
-        bucket = jnp.searchsorted(cum, flat, side="right").astype(jnp.int32)
-        bucket_c = jnp.clip(bucket, 0, n - 1)
-        within = jnp.clip(flat - starts2[bucket_c], 0, cap - 1)
-        total = jnp.sum(r_counts).astype(jnp.int32)
+        flat = jnp.arange(out_cap, dtype=jnp.int32)
+        bkt = jnp.clip(jnp.searchsorted(
+            cum, flat, side="right").astype(jnp.int32), 0, n - 1)
+        within = jnp.clip(flat - starts2[bkt], 0, cap - 1)
         live_o = flat < total
+
         outs = []
-        for r in recv:
-            g = r[bucket_c, within]  # [out_cap, ...trailing]
-            mask = live_o.reshape(live_o.shape + (1,) * (g.ndim - 1))
-            outs.append(jnp.where(mask, g, jnp.zeros((), g.dtype)))
-        return [o[None] for o in outs], total[None]
+        wi = 0
+        for kind, vi in recv_plan:
+            if kind == "varlen":
+                relem, rlens, rvalid, recounts = (
+                    wire[wi], wire[wi + 1], wire[wi + 2], wire[wi + 3])
+                wi += 4
+                lens_o = jnp.where(live_o, rlens[bkt, within], 0)
+                offs_o = jnp.concatenate([
+                    jnp.zeros(1, jnp.int32),
+                    jnp.cumsum(lens_o).astype(jnp.int32)])
+                ecap = ecaps[vi]
+                oecap = out_ecaps[vi]
+                ecum = jnp.cumsum(recounts)
+                eexcl = ecum - recounts
+                p = jnp.arange(oecap, dtype=jnp.int32)
+                eb = jnp.clip(jnp.searchsorted(
+                    ecum, p, side="right").astype(jnp.int32), 0, n - 1)
+                ew = jnp.clip(p - eexcl[eb], 0, ecap - 1)
+                elem_o = jnp.where(p < ecum[n - 1], relem[eb, ew],
+                                   jnp.zeros((), relem.dtype))
+                valid_o = jnp.where(live_o, rvalid[bkt, within], False)
+                outs += [elem_o[None], offs_o[None], valid_o[None]]
+            else:
+                rdata, rvalid = wire[wi], wire[wi + 1]
+                wi += 2
+                data_o = jnp.where(live_o, rdata[bkt, within],
+                                   jnp.zeros((), rdata.dtype))
+                valid_o = jnp.where(live_o, rvalid[bkt, within], False)
+                outs += [data_o[None], valid_o[None]]
+        outs.append(total[None])
+        return outs
 
     from jax import shard_map
-    in_specs = ([P(DATA_AXIS, *([None] * nd)) for nd in ndims],
-                P(DATA_AXIS), P(DATA_AXIS, None))
-    out_specs = ([P(DATA_AXIS, *([None] * nd)) for nd in ndims],
-                 P(DATA_AXIS))
-    return jax.jit(shard_map(spmd, mesh=mesh, in_specs=in_specs,
+    in_specs = []
+    for is_varlen in sig:
+        k = 3 if is_varlen else 2
+        in_specs += [P(DATA_AXIS, None)] * k
+    in_specs += [P(DATA_AXIS, None), P(DATA_AXIS)]
+    out_specs = []
+    for is_varlen in sig:
+        k = 3 if is_varlen else 2
+        out_specs += [P(DATA_AXIS, None)] * k
+    out_specs.append(P(DATA_AXIS))
+    return jax.jit(shard_map(spmd, mesh=mesh, in_specs=(in_specs,),
                              out_specs=out_specs))
 
 
-_exchange_fn_cache: dict = {}
+# Compiled exchange programs, keyed by (mesh, schema signature, capacities).
+# LRU-capped: every new capacity bucket x schema shape compiles and retains
+# an SPMD program, the same pathology the plan-fingerprint cache caps.
+_EXCHANGE_CACHE_MAX = 64
+_exchange_fn_cache: "OrderedDict" = None  # type: ignore[assignment]
 
 
-def _cached_payload_exchange_fn(mesh: Mesh, ndims: Tuple[int, ...],
-                                cap: int):
-    key = (mesh, ndims, cap)
+def _cached(key, builder):
+    global _exchange_fn_cache
+    if _exchange_fn_cache is None:
+        from collections import OrderedDict
+        _exchange_fn_cache = OrderedDict()
     fn = _exchange_fn_cache.get(key)
     if fn is None:
-        fn = make_payload_exchange_fn(mesh, ndims, cap)
+        fn = builder()
         _exchange_fn_cache[key] = fn
+        while len(_exchange_fn_cache) > _EXCHANGE_CACHE_MAX:
+            _exchange_fn_cache.popitem(last=False)
+    else:
+        _exchange_fn_cache.move_to_end(key)
     return fn
-
-
-@functools.partial(jax.jit, static_argnames=("byte_cap",))
-def _padded_to_flat(mat, lens, byte_cap: int):
-    """Rebuild the cudf (offsets, flat bytes) layout from a padded byte
-    matrix: one cumsum + one searchsorted-driven gather."""
-    out_cap, maxlen = int(mat.shape[0]), int(mat.shape[1])
-    offsets = jnp.concatenate([
-        jnp.zeros(1, jnp.int32),
-        jnp.cumsum(lens).astype(jnp.int32)])
-    j = jnp.arange(byte_cap, dtype=jnp.int32)
-    row = jnp.searchsorted(offsets[1:], j, side="right").astype(jnp.int32)
-    row_c = jnp.clip(row, 0, out_cap - 1)
-    within = jnp.clip(j - offsets[row_c], 0, max(maxlen - 1, 0))
-    data = jnp.where(j < offsets[-1], mat[row_c, within], 0).astype(jnp.uint8)
-    return data, offsets
 
 
 def mesh_exchange_batches(mesh: Mesh, local_batches, pids_list,
@@ -267,131 +398,110 @@ def mesh_exchange_batches(mesh: Mesh, local_batches, pids_list,
 
     ``local_batches``: one ColumnBatch (or None) per mesh device.
     ``pids_list``: per-batch int32[cap] destination device ids in [0, n).
-    Returns one ColumnBatch per device with capacity n*cap_common; output
-    ``num_rows`` stays a device scalar (no host sync on this path).
+    Returns one ColumnBatch per device; every array in the outputs is a
+    plain single-device array on its mesh device, and no payload buffer
+    touches the host anywhere on this path.
     """
     from spark_rapids_tpu.batch import round_up_capacity
     n = mesh.shape[DATA_AXIS]
+    devices = list(mesh.devices.flat)
     assert len(local_batches) == n and len(pids_list) == n
     present = [i for i, b in enumerate(local_batches) if b is not None]
     if not present:
         return []
 
-    # one bulk fetch of every raw buffer (+ pids) — single round trip
-    fetch = []
-    for i in present:
-        b = local_batches[i]
-        fetch.append((b.num_rows, pids_list[i],
-                      [(c.data, c.validity, c.offsets) if c.is_string
-                       else (c.data, c.validity) for c in b.columns]))
-    host = jax.device_get(fetch)
-
-    cap = round_up_capacity(max(max(int(h[0]) for h in host), 1))
-    str_cols = [i for i, f in enumerate(schema.fields) if f.dtype.is_string]
-    maxlens = {}
-    for ci in str_cols:
-        m = 1
-        for h in host:
-            nrows = int(h[0])
-            offs = np.asarray(h[2][ci][2])
-            if nrows:
-                m = max(m, int(np.max(offs[1:nrows + 1] - offs[:nrows])))
-        maxlens[ci] = round_up_capacity(m, minimum=8)
-
-    # build stacked [n, cap, ...] payloads on host
-    payload_np: List[np.ndarray] = []
-    ndims: List[int] = []
-    col_payload_slots = []  # per schema col: indices into payload list
+    # Common static capacities, sized by LIVE rows/elements — one scalar
+    # metadata round trip (the analogue of the reference's metadata
+    # request/response before buffer transfer), so a sparse batch that kept
+    # a huge input capacity doesn't inflate the wire shapes n-fold.
+    from spark_rapids_tpu.batch import host_sizes
+    sizes = host_sizes([local_batches[i] for i in present])
+    cap = round_up_capacity(max(max(r for r, _ in sizes), 1))
+    sig = tuple(f.dtype.is_string or getattr(f.dtype, "is_array", False)
+                for f in schema.fields)
+    ecaps = {}
+    vi = 0
     for ci, f in enumerate(schema.fields):
-        if f.dtype.is_string:
-            ml = maxlens[ci]
-            col_payload_slots.append((len(payload_np),))
-            payload_np.append(np.zeros((n, cap, ml), dtype=np.uint8))
-            payload_np.append(np.zeros((n, cap), dtype=np.int32))
-            payload_np.append(np.zeros((n, cap), dtype=np.bool_))
-            ndims.extend([2, 1, 1])
-        else:
-            col_payload_slots.append((len(payload_np),))
-            payload_np.append(np.zeros((n, cap), dtype=f.dtype.np_dtype))
-            payload_np.append(np.zeros((n, cap), dtype=np.bool_))
-            ndims.extend([1, 1])
-    num_rows_np = np.zeros(n, dtype=np.int32)
-    pids_np = np.zeros((n, cap), dtype=np.int32)
+        if sig[ci]:
+            ecaps[ci] = round_up_capacity(
+                max(max(totals[vi] for _, totals in sizes), 1), minimum=16)
+            vi += 1
+    out_cap = round_up_capacity(n * cap)
+    out_ecaps = {ci: round_up_capacity(n * e) for ci, e in ecaps.items()}
 
-    for h, dev in zip(host, present):
-        nrows = int(h[0])
-        num_rows_np[dev] = nrows
-        if nrows == 0:
-            continue
-        pids_np[dev, :nrows] = np.asarray(h[1])[:nrows]
-        slot = 0
-        for ci, f in enumerate(schema.fields):
-            bufs = h[2][ci]
-            if f.dtype.is_string:
-                data = np.asarray(bufs[0])
-                valid = np.asarray(bufs[1])
-                offs = np.asarray(bufs[2]).astype(np.int64)
-                ml = maxlens[ci]
-                lens = (offs[1:nrows + 1] - offs[:nrows]).astype(np.int32)
-                idx = np.clip(offs[:nrows, None] +
-                              np.arange(ml, dtype=np.int64)[None, :],
-                              0, max(len(data) - 1, 0))
-                mask = np.arange(ml, dtype=np.int32)[None, :] < lens[:, None]
-                payload_np[slot][dev, :nrows] = np.where(
-                    mask, data[idx], 0)
-                payload_np[slot + 1][dev, :nrows] = lens
-                payload_np[slot + 2][dev, :nrows] = valid[:nrows]
-                slot += 3
-            else:
-                payload_np[slot][dev, :nrows] = np.asarray(bufs[0])[:nrows]
-                payload_np[slot + 1][dev, :nrows] = \
-                    np.asarray(bufs[1])[:nrows]
-                slot += 2
+    sig_key = tuple((f.dtype, sig[ci]) for ci, f in enumerate(schema.fields))
+    ecaps_t = tuple(ecaps.get(ci, 0) for ci in range(len(schema.fields)))
+    oecaps_t = tuple(out_ecaps.get(ci, 0) for ci in range(len(schema.fields)))
+
+    pack = _cached(("pack", mesh, sig_key, cap, ecaps_t),
+                   lambda: _make_pack_fn(schema, cap, ecaps))
+    fn = _cached(("spmd", mesh, sig_key, cap, ecaps_t, out_cap, oecaps_t),
+                 lambda: _make_mesh_payload_fn(
+                     mesh, sig, cap, ecaps_t, out_cap, oecaps_t))
+
+    # Per-device pack on the mesh device (device-to-device placement only).
+    shards_per_payload = None
+    for d in range(n):
+        b = local_batches[d]
+        if b is None:
+            cols, nr, pid = _empty_cols(schema, ecaps), 0, \
+                jnp.zeros(cap, jnp.int32)
+        else:
+            cols, nr, pid = list(b.columns), b.num_rows, pids_list[d]
+        moved = jax.device_put((cols, nr, pid), devices[d])
+        payloads = pack(*moved)
+        if shards_per_payload is None:
+            shards_per_payload = [[] for _ in payloads]
+        for si, p in enumerate(payloads):
+            shards_per_payload[si].append(p)
 
     sh2 = NamedSharding(mesh, P(DATA_AXIS, None))
-    sh3 = NamedSharding(mesh, P(DATA_AXIS, None, None))
     sh1 = NamedSharding(mesh, P(DATA_AXIS))
-    payloads = [jax.device_put(p, sh3 if p.ndim == 3 else sh2)
-                for p in payload_np]
-    d_rows = jax.device_put(num_rows_np, sh1)
-    d_pids = jax.device_put(pids_np, sh2)
+    globals_ = []
+    for shards in shards_per_payload:
+        tail = shards[0].shape[1:]
+        sh = sh2 if tail else sh1
+        globals_.append(jax.make_array_from_single_device_arrays(
+            (n,) + tail, sh, shards))
 
-    fn = _cached_payload_exchange_fn(mesh, tuple(ndims), cap)
-    out_payloads, counts = fn(payloads, d_rows, d_pids)
+    outs = fn(globals_)
 
-    # Materialize per-device LOCAL batches: slicing the mesh-sharded
-    # globals lazily would make every downstream per-partition program a
-    # hidden cross-device collective — interleaved consumers (join sides,
-    # AQE groups) then deadlock the rendezvous.  One staged host hop keeps
-    # all post-shuffle work strictly local, like the reference's receive
-    # side landing bounce buffers into device-local batches.
-    host_payloads = jax.device_get(list(out_payloads))
-    counts_h = np.asarray(jax.device_get(counts))
-
-    out_cap = n * cap
-    out: List[ColumnBatch] = []
+    # Unshard: collect each device's shard of every output, squeeze the
+    # shard axis in one per-device dispatch.
+    per_dev_arrays = [[] for _ in range(n)]
+    dev_pos = {d: i for i, d in enumerate(devices)}
+    for g in outs:
+        for shard in g.addressable_shards:
+            per_dev_arrays[dev_pos[shard.device]].append(shard.data)
+    results: List[ColumnBatch] = []
     for d in range(n):
+        arrs = _unshard(per_dev_arrays[d])
         cols = []
-        slot = 0
+        ai = 0
         for ci, f in enumerate(schema.fields):
-            if f.dtype.is_string:
-                ml = maxlens[ci]
-                byte_cap = round_up_capacity(max(out_cap * ml, 16),
-                                             minimum=16)
-                data, offsets = _padded_to_flat(
-                    jnp.asarray(host_payloads[slot][d]),
-                    jnp.asarray(host_payloads[slot + 1][d]),
-                    byte_cap)
-                cols.append(DeviceColumn(
-                    f.dtype, data,
-                    jnp.asarray(host_payloads[slot + 2][d]), offsets))
-                slot += 3
+            if sig[ci]:
+                elem, offs, valid = arrs[ai], arrs[ai + 1], arrs[ai + 2]
+                ai += 3
+                cols.append(DeviceColumn(f.dtype, elem, valid, offs))
             else:
-                cols.append(DeviceColumn(
-                    f.dtype, jnp.asarray(host_payloads[slot][d]),
-                    jnp.asarray(host_payloads[slot + 1][d]), None))
-                slot += 2
-        out.append(ColumnBatch(schema, cols,
-                               jnp.asarray(int(counts_h[d]), jnp.int32),
-                               out_cap))
-    return out
+                data, valid = arrs[ai], arrs[ai + 1]
+                ai += 2
+                cols.append(DeviceColumn(f.dtype, data, valid, None))
+        results.append(ColumnBatch(schema, cols, arrs[ai], out_cap))
+    return results
+
+
+def _empty_cols(schema, ecaps):
+    cols = []
+    for ci, f in enumerate(schema.fields):
+        if f.dtype.is_string or getattr(f.dtype, "is_array", False):
+            edt = jnp.uint8 if f.dtype.is_string \
+                else f.dtype.element.np_dtype
+            cols.append(DeviceColumn(
+                f.dtype, jnp.zeros(ecaps[ci], edt),
+                jnp.zeros(1, jnp.bool_), jnp.zeros(2, jnp.int32)))
+        else:
+            cols.append(DeviceColumn(
+                f.dtype, jnp.zeros(1, f.dtype.np_dtype),
+                jnp.zeros(1, jnp.bool_), None))
+    return cols

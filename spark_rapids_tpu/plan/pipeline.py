@@ -148,6 +148,8 @@ def _run_stage(root: PhysicalOp, ctx: ExecContext) -> List[ColumnBatch]:
         root._stage_cache = cached
     sources, jitted = cached
     args = tuple(tuple(_materialize_source(s, ctx)) for s in sources)
+    from spark_rapids_tpu.batch import colocate_batches
+    args = tuple(tuple(bs) for bs in colocate_batches(args))
     ctx.metric("pipeline", "programs").add(1)
     return _shrink_outputs(list(jitted(args)), ctx)
 

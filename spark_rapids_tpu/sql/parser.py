@@ -508,7 +508,17 @@ def _build_function(name: str, args: List[Expression], star: bool,
     if name == "count":
         if star or not args:
             return A.count_star()
+        if distinct:
+            if len(args) != 1:
+                raise NotImplementedError(
+                    "COUNT(DISTINCT a, b, ...) over multiple columns is "
+                    "not supported")
+            return A.CountDistinct(args[0])
         return A.Count(args[0])
+    if distinct:
+        raise NotImplementedError(
+            f"{name.upper()}(DISTINCT ...) is not supported; only "
+            f"COUNT(DISTINCT x)")
     simple = {
         "sum": A.Sum, "avg": A.Average, "mean": A.Average, "min": A.Min,
         "max": A.Max, "first": A.First, "last": A.Last,

@@ -383,3 +383,73 @@ def test_canonical_plan_reuse():
     # different plan shape -> miss
     g3 = df.group_by("a").sum("b").filter(Column(ColumnRef("a")) > 1)
     assert s.plan_physical(g3.plan) is not s.plan_physical(g1.plan)
+
+
+# ---------------------------------------------------------------------------
+# count(DISTINCT x): the two-level distinct-aggregate rewrite
+# ---------------------------------------------------------------------------
+
+
+def _cd_df(s, n=200):
+    import numpy as np
+    rng = np.random.RandomState(7)
+    cats = ["a", "b", "c", None, "dd"]
+    return s.create_dataframe({
+        "k": (T.INT, rng.randint(0, 4, n)),
+        "v": (T.STRING, [cats[i] for i in rng.randint(0, len(cats), n)]),
+        "w": (T.LONG, [None if i % 11 == 0 else int(x) for i, x in
+                       enumerate(rng.randint(0, 100, n))]),
+    }, num_partitions=3)
+
+
+def test_count_distinct_alone():
+    from spark_rapids_tpu import functions as F
+    assert_tpu_cpu_equal(
+        lambda s: _cd_df(s).group_by("k").agg(
+            F.count_distinct("v").alias("cd")))
+
+
+def test_count_distinct_with_other_aggs():
+    from spark_rapids_tpu import functions as F
+    assert_tpu_cpu_equal(
+        lambda s: _cd_df(s).group_by("k").agg(
+            F.count_distinct("v").alias("cd"),
+            F.sum("w").alias("sw"),
+            F.count("w").alias("cw"),
+            F.min("w").alias("mn"),
+            F.max("w").alias("mx")))
+
+
+def test_count_distinct_with_avg():
+    from spark_rapids_tpu import functions as F
+    assert_tpu_cpu_equal(
+        lambda s: _cd_df(s).group_by("k").agg(
+            F.avg("w").alias("aw"),
+            F.count_distinct("v").alias("cd")),
+        approx=True)
+
+
+def test_count_distinct_global():
+    from spark_rapids_tpu import functions as F
+    assert_tpu_cpu_equal(
+        lambda s: _cd_df(s).agg(F.count_distinct("v").alias("cd"),
+                                F.sum("w").alias("sw")))
+
+
+def test_count_distinct_int_col_twice():
+    from spark_rapids_tpu import functions as F
+    assert_tpu_cpu_equal(
+        lambda s: _cd_df(s).group_by("v").agg(
+            F.count_distinct("w").alias("cd1"),
+            F.count_distinct(F.col("w")).alias("cd2")))
+
+
+def test_count_distinct_mixed_columns_rejected():
+    import pytest
+    from spark_rapids_tpu import functions as F
+    from tests.compare import tpu_session
+    s = tpu_session()
+    df = _cd_df(s)
+    with pytest.raises(NotImplementedError):
+        df.group_by("k").agg(F.count_distinct("v"),
+                             F.count_distinct("w"))

@@ -93,3 +93,19 @@ def test_sql_new_string_datetime_bitwise_functions():
             "       from_unixtime(t) AS ts "
             "FROM t1")
     assert_tpu_cpu_equal(q)
+
+
+def test_sql_count_distinct():
+    from compare import assert_tpu_cpu_equal
+
+    def q(sess):
+        df = sess.create_dataframe({
+            "k": [1, 1, 2, 2, 2, None, 1],
+            "v": ["a", "a", "b", None, "c", "c", "d"],
+        })
+        df.create_or_replace_temp_view("cd")
+        return sess.sql(
+            "SELECT k, COUNT(DISTINCT v) AS cd, COUNT(v) AS c, "
+            "       SUM(k) AS sk "
+            "FROM cd GROUP BY k")
+    assert_tpu_cpu_equal(q)
