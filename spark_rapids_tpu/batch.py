@@ -392,6 +392,36 @@ def host_sizes(batches: Sequence[ColumnBatch]) -> List[Tuple[int, List[int]]]:
     return [(int(n), [int(t) for t in totals]) for n, totals in host]
 
 
+def fixed_row_bytes(schema: T.Schema) -> int:
+    """Estimated fixed-width bytes per row: data itemsize plus one validity
+    byte per column; varlen columns contribute their 4-byte offset entry
+    (element bytes are accounted separately from offsets[-1]).  This is the
+    size estimate AQE uses for byte-based targets (the reference's
+    map-status byte sizes)."""
+    total = 0
+    for f in schema.fields:
+        dt = f.dtype
+        if dt.is_string or dt.is_array:
+            total += 5
+        else:
+            total += int(np.dtype(dt.np_dtype).itemsize) + 1
+    return total
+
+
+def varlen_byte_scales(schema: T.Schema) -> List[int]:
+    """Per-varlen-column multiplier converting offsets[-1] element totals
+    to bytes: 1 for strings (elements ARE bytes), element itemsize for
+    arrays.  Order matches the varlen-column order host_sizes and
+    gather_rows use."""
+    out = []
+    for f in schema.fields:
+        if f.dtype.is_string:
+            out.append(1)
+        elif f.dtype.is_array:
+            out.append(int(np.dtype(f.dtype.element.np_dtype).itemsize))
+    return out
+
+
 def colocate_batches(batches: Sequence[ColumnBatch]
                      ) -> Sequence[ColumnBatch]:
     """Move batches onto one device when they span several.

@@ -269,7 +269,26 @@ AQE_COALESCE_ENABLED = conf_bool(
     "coalesce by combined size to stay co-partitioned.")
 AQE_TARGET_ROWS = conf_int(
     "spark.rapids.sql.adaptive.targetPartitionRows", 1 << 16,
-    "Row-count target per coalesced post-shuffle partition.")
+    "Row-count target per coalesced post-shuffle partition (used only "
+    "when the exchange did not record byte sizes).")
+AQE_TARGET_BYTES = conf_bytes(
+    "spark.rapids.sql.adaptive.advisoryPartitionSizeInBytes", 64 << 20,
+    "Byte-size target per coalesced post-shuffle partition; preferred "
+    "over the row target whenever the exchange recorded per-piece bytes "
+    "(the reference coalesces by map-status bytes, GpuCoalesceBatches "
+    "goals).")
+AQE_REPLAN_JOINS = conf_bool(
+    "spark.rapids.sql.adaptive.replanJoins.enabled", True,
+    "At execution time, convert a shuffled hash join whose build side "
+    "came in under spark.sql.autoBroadcastJoinThreshold (by shuffle-known "
+    "bytes) into the broadcast path (GpuCustomShuffleReaderExec / AQE "
+    "OptimizeShuffledHashJoin role).")
+AQE_SKEW_FACTOR = conf_float(
+    "spark.rapids.sql.adaptive.skewJoin.skewedPartitionFactor", 5.0,
+    "A coalesced join partition is considered skewed when its size "
+    "exceeds this multiple of the median partition size (and the "
+    "advisory target); the stream side is then joined in bounded chunks "
+    "against the full build side.")
 CSV_ENABLED = conf_bool(
     "spark.rapids.sql.format.csv.enabled", True,
     "Enable TPU-accelerated CSV scans.")

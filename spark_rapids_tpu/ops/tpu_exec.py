@@ -269,6 +269,29 @@ def _aqe_target_rows(ctx) -> int:
     return AQE_TARGET_ROWS.get(ctx.conf)
 
 
+def _aqe_target_bytes(ctx) -> int:
+    from spark_rapids_tpu.config import AQE_TARGET_BYTES
+    return AQE_TARGET_BYTES.get(ctx.conf)
+
+
+def _aqe_part_stats(child, n_parts):
+    """Shuffle-recorded per-partition sizes: (sizes, unit) preferring bytes
+    over rows (the reference coalesces by map-status BYTES — row targets are
+    an order of magnitude off for wide or string-heavy rows).  Returns
+    (None, None) when the child recorded nothing (non-exchange child)."""
+    for attr, unit in (("_last_part_bytes", "bytes"),
+                       ("_last_part_rows", "rows")):
+        v = getattr(child, attr, None)
+        if v is not None and len(v) == n_parts:
+            return v, unit
+    return None, None
+
+
+def _aqe_target_for(ctx, unit) -> int:
+    return _aqe_target_bytes(ctx) if unit == "bytes" \
+        else _aqe_target_rows(ctx)
+
+
 def _group_by_target(items: List, sizes: List[int], target: int
                      ) -> List[List]:
     """Group consecutive items until each group reaches target rows — the
@@ -321,12 +344,12 @@ class TpuCoalescedShuffleReaderExec(TpuExec):
         lazy_parts = child.partitions(ctx)
         if not _aqe_enabled(ctx) or len(lazy_parts) <= 1:
             return lazy_parts
-        rows = getattr(child, "_last_part_rows", None)
-        if rows is not None and len(rows) == len(lazy_parts):
+        sizes, unit = _aqe_part_stats(child, len(lazy_parts))
+        if sizes is not None:
             # spill-friendly path: sizes came with the shuffle (no unspill
             # just to count rows); chain the lazy generators per group
-            groups = _group_by_target(lazy_parts, rows,
-                                      _aqe_target_rows(ctx))
+            groups = _group_by_target(lazy_parts, sizes,
+                                      _aqe_target_for(ctx, unit))
             ctx.metric(self.op_id, "coalescedTo").add(len(groups))
             return [itertools.chain(*g) for g in groups]
         parts = [list(p) for p in lazy_parts]
@@ -637,12 +660,12 @@ class TpuHashAggregateExec(TpuExec):
             lazy_parts = child.partitions(ctx)
             all_sizes: dict = {}
             if _aqe_enabled(ctx) and len(lazy_parts) > 1:
-                target = _aqe_target_rows(ctx)
-                rows = getattr(child, "_last_part_rows", None)
-                if rows is not None and len(rows) == len(lazy_parts):
+                sizes, unit = _aqe_part_stats(child, len(lazy_parts))
+                if sizes is not None:
                     # spill-friendly: shuffle-known sizes, lazy chaining
                     parts = [itertools.chain(*g) for g in
-                             _group_by_target(lazy_parts, rows, target)]
+                             _group_by_target(lazy_parts, sizes,
+                                              _aqe_target_for(ctx, unit))]
                 else:
                     mats = [list(p) for p in lazy_parts]
                     # one round trip for every batch's sizes across ALL
@@ -654,7 +677,8 @@ class TpuHashAggregateExec(TpuExec):
                                  for b, s in zip(flat, flat_sizes)}
                     sizes = [sum(all_sizes[id(b)][0] for b in p)
                              for p in mats]
-                    parts = _coalesce_partition_lists(mats, sizes, target)
+                    parts = _coalesce_partition_lists(
+                        mats, sizes, _aqe_target_rows(ctx))
             else:
                 parts = lazy_parts
 
@@ -751,18 +775,23 @@ class TpuShuffledHashJoinExec(TpuExec):
         lparts = lchild.partitions(ctx)
         rparts = rchild.partitions(ctx)
         assert len(lparts) == len(rparts)
+        skew_flags = [False] * len(lparts)
 
         if _aqe_enabled(ctx) and len(lparts) > 1:
+            bc_side = self._replan_broadcast_side(ctx, len(lparts))
+            if bc_side is not None:
+                return self._broadcast_partitions(ctx, bc_side,
+                                                  lparts, rparts)
             # AQE pair coalescing: group co-partitioned (left, right) pairs
-            # by COMBINED row count so both sides stay aligned
+            # by COMBINED size so both sides stay aligned
             # (GpuCustomShuffleReaderExec role for joins).
-            lrows = getattr(lchild, "_last_part_rows", None)
-            rrows = getattr(rchild, "_last_part_rows", None)
-            if lrows is not None and rrows is not None and \
-                    len(lrows) == len(lparts) == len(rrows):
+            lsz, lunit = _aqe_part_stats(lchild, len(lparts))
+            rsz, runit = _aqe_part_stats(rchild, len(rparts))
+            if lsz is not None and rsz is not None and lunit == runit:
                 # spill-friendly: shuffle-known sizes, lazy chaining (each
                 # group's pieces unspill only when that pair is joined)
-                sizes = [a + b for a, b in zip(lrows, rrows)]
+                sizes = [a + b for a, b in zip(lsz, rsz)]
+                unit = lunit
             else:
                 lparts = [list(p) for p in lparts]
                 rparts = [list(p) for p in rparts]
@@ -774,23 +803,156 @@ class TpuShuffledHashJoinExec(TpuExec):
                 sizes = [sum(by_id[id(b)] for b in lp) +
                          sum(by_id[id(b)] for b in rp)
                          for lp, rp in zip(lparts, rparts)]
-            groups = _group_by_target(list(zip(lparts, rparts)), sizes,
-                                      _aqe_target_rows(ctx))
-            lparts = [itertools.chain(*(lp for lp, _ in g))
+                unit = "rows"
+            target = _aqe_target_for(ctx, unit)
+            groups = _group_by_target(
+                list(zip(lparts, rparts, sizes)), sizes, target)
+            lparts = [itertools.chain(*(lp for lp, _, _ in g))
                       for g in groups]
-            rparts = [itertools.chain(*(rp for _, rp in g))
+            rparts = [itertools.chain(*(rp for _, rp, _ in g))
                       for g in groups]
+            # Skew detection (AQE OptimizeSkewedJoin role): a RAW pair far
+            # above the median raw-pair size AND the advisory target marks
+            # its group skewed — joined in chunks rather than one giant
+            # concat+join.  (Median over raw pairs, not coalesced groups:
+            # with few groups the skewed group itself drags the median up.)
+            import statistics
+            from spark_rapids_tpu.config import AQE_SKEW_FACTOR
+            med = statistics.median(sizes) if sizes else 0
+            factor = AQE_SKEW_FACTOR.get(ctx.conf)
+            # med may be 0 (most partitions empty, one hot key): any
+            # nonzero pair above the target is then skewed
+            skew_flags = [
+                any(s > factor * med and s > target for _, _, s in g)
+                for g in groups]
 
-        def gen(lp, rp):
+        def gen(lp, rp, skewed):
             lbs, rbs = list(lp), list(rp)
             _reserve_for(ctx, lbs + rbs)
+            if skewed and self.how != "full":
+                yield from self._join_skewed(ctx, lbs, rbs)
+                return
             lb = _concat_all(lbs, self.children[0].output_schema)
             rb = _concat_all(rbs, self.children[1].output_schema)
             out = self._join_pair(lb, rb)
             if out is not None:
                 yield out
 
-        return [gen(lp, rp) for lp, rp in zip(lparts, rparts)]
+        return [gen(lp, rp, sk)
+                for lp, rp, sk in zip(lparts, rparts, skew_flags)]
+
+    def _replan_broadcast_side(self, ctx, n) -> Optional[str]:
+        """AQE runtime join replan (GpuCustomShuffleReaderExec +
+        GpuOverrides AQE prep role): when the shuffle recorded build-side
+        BYTES under spark.sql.autoBroadcastJoinThreshold, drop per-pair
+        joining and run the broadcast shape — one device-resident build,
+        each stream partition joined against it, no pair alignment."""
+        from spark_rapids_tpu.config import (
+            AQE_REPLAN_JOINS, AUTO_BROADCAST_THRESHOLD,
+        )
+        if not AQE_REPLAN_JOINS.get(ctx.conf):
+            return None
+        thr = AUTO_BROADCAST_THRESHOLD.get(ctx.conf)
+        if thr < 0:
+            return None
+        lchild, rchild = self.children
+        cands = []
+        if self.how in ("inner", "left", "left_semi", "left_anti", "cross"):
+            rbytes = getattr(rchild, "_last_part_bytes", None)
+            if rbytes is not None and len(rbytes) == n and \
+                    sum(rbytes) <= thr:
+                cands.append(("right", sum(rbytes)))
+        if self.how in ("inner", "right", "cross"):
+            lbytes = getattr(lchild, "_last_part_bytes", None)
+            if lbytes is not None and len(lbytes) == n and \
+                    sum(lbytes) <= thr:
+                cands.append(("left", sum(lbytes)))
+        if not cands:
+            return None
+        return min(cands, key=lambda c: c[1])[0]
+
+    def _broadcast_partitions(self, ctx, side, lparts, rparts):
+        """Execute as a broadcast join: materialize the small side once,
+        join every stream partition against it."""
+        stream_parts = lparts if side == "right" else rparts
+        build_parts = rparts if side == "right" else lparts
+        build_schema = self.children[1 if side == "right" else 0] \
+            .output_schema
+        stream_schema = self.children[0 if side == "right" else 1] \
+            .output_schema
+        bbs = [b for p in build_parts for b in p]
+        _reserve_for(ctx, bbs)
+        bc = _concat_all(bbs, build_schema)
+        ctx.metric(self.op_id, "replannedBroadcast").add(1)
+
+        def gen(part):
+            sbs = list(part)
+            if not sbs:
+                return
+            _reserve_for(ctx, sbs)
+            sb = _concat_all(sbs, stream_schema)
+            lb, rb = (sb, bc) if side == "right" else (bc, sb)
+            out = self._join_pair(lb, rb)
+            if out is not None:
+                yield out
+
+        return [gen(p) for p in stream_parts]
+
+    def _join_skewed(self, ctx, lbs, rbs):
+        """Skewed-group handling (AQE OptimizeSkewedJoin role): join the
+        stream side in bounded-byte chunks against the full build side
+        instead of one giant concat+join.  Stream rows belong to exactly
+        one chunk, so outer null-padding of the stream side per chunk
+        stays correct; 'full' tracks unmatched rows on BOTH sides and is
+        never chunked (caller guards)."""
+        from spark_rapids_tpu.batch import (
+            fixed_row_bytes, host_sizes, varlen_byte_scales,
+        )
+        split_left = self.how != "right"
+        stream = lbs if split_left else rbs
+        build = rbs if split_left else lbs
+        stream_schema = self.children[0 if split_left else 1].output_schema
+        build_schema = self.children[1 if split_left else 0].output_schema
+        stream_b = _concat_all(stream, stream_schema)
+        build_b = _concat_all(build, build_schema)
+        if stream_b is None:
+            out = self._join_pair(
+                *((stream_b, build_b) if split_left
+                  else (build_b, stream_b)))
+            if out is not None:
+                yield out
+            return
+        # row-granularity chunks sized to the advisory byte target: the
+        # join's pair-space allocation is bounded per chunk even when the
+        # whole skewed partition arrived as one piece.  ONE host round
+        # trip yields rows + varlen totals together.
+        total_rows, vtotals = host_sizes([stream_b])[0]
+        total_bytes = total_rows * fixed_row_bytes(stream_b.schema) + \
+            sum(t * s for t, s in
+                zip(vtotals, varlen_byte_scales(stream_b.schema)))
+        target = max(_aqe_target_bytes(ctx), 1)
+        n_chunks = max(1, min(max(total_rows, 1),
+                              -(-total_bytes // target)))
+        rows_per = -(-max(total_rows, 1) // n_chunks)
+        bounds = list(range(0, total_rows, rows_per)) + [total_rows]
+        varlen = [c for c in stream_b.columns if c.is_varlen]
+        marks = jax.device_get(
+            [c.offsets[jnp.asarray(bounds, jnp.int32)] for c in varlen]) \
+            if varlen else []
+        ctx.metric(self.op_id, "skewSplitChunks").add(len(bounds) - 1)
+        for i in range(len(bounds) - 1):
+            start, cnt = bounds[i], bounds[i + 1] - bounds[i]
+            pcap = round_up_capacity(cnt)
+            idx = start + jnp.arange(pcap, dtype=jnp.int32)
+            bcaps = [round_up_capacity(max(int(m[i + 1] - m[i]), 16),
+                                       minimum=16) for m in marks]
+            sb = gather_rows(stream_b, idx, jnp.asarray(cnt, jnp.int32),
+                             out_capacity=pcap,
+                             out_byte_caps=bcaps or None)
+            lb, rb = (sb, build_b) if split_left else (build_b, sb)
+            out = self._join_pair(lb, rb)
+            if out is not None:
+                yield out
 
     def _join_pair(self, lb, rb) -> Optional[ColumnBatch]:
         lsch = self.children[0].output_schema

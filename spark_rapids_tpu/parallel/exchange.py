@@ -171,7 +171,11 @@ class TpuShuffleExchangeExec(TpuExec):
         counts = jnp.zeros(n + 1, jnp.int32).at[ids].add(1)[:n]
         byte_totals = []
         for c in batch.columns:
-            if c.is_string:
+            # ALL varlen columns (strings AND arrays), in column order —
+            # the split's out_byte_caps align positionally with
+            # gather_rows' varlen columns; totals are in element units
+            # (bytes for strings, element count for arrays)
+            if c.is_varlen:
                 lens = (c.offsets[1:] - c.offsets[:-1]).astype(jnp.int64)
                 byte_totals.append(jax.ops.segment_sum(
                     lens, ids, num_segments=n + 1)[:n])
@@ -284,6 +288,11 @@ class TpuShuffleExchangeExec(TpuExec):
         if cached is not None and cached[0]() is ctx:
             return [self._drain_cached(p) for p in cached[1]]
         catalog = DeviceRuntime.get(ctx.conf).catalog
+        from spark_rapids_tpu.batch import (
+            fixed_row_bytes, varlen_byte_scales,
+        )
+        frb = fixed_row_bytes(self.output_schema)
+        vscales = varlen_byte_scales(self.output_schema)
         out: List[List] = [[] for _ in range(n)]
         for pi, batches in enumerate(all_batches):
             for db in batches:
@@ -313,6 +322,9 @@ class TpuShuffleExchangeExec(TpuExec):
                                     out_byte_caps=bcaps or None)
                     h = catalog.register(piece, PRIORITY_SHUFFLE_OUTPUT)
                     h.piece_rows = cnt  # host-known: no sync for AQE sizing
+                    h.piece_bytes = cnt * frb + \
+                        sum(int(bh[p]) * sc
+                            for bh, sc in zip(bytes_h, vscales))
                     ctx.defer_close(h)
                     out[p].append(h)
                     offset += cnt
@@ -321,6 +333,7 @@ class TpuShuffleExchangeExec(TpuExec):
         # batches just to count rows (GpuCustomShuffleReaderExec's use of
         # map-status sizes)
         self._last_part_rows = [sum(h.piece_rows for h in p) for p in out]
+        self._last_part_bytes = [sum(h.piece_bytes for h in p) for p in out]
         self._split_cache = (weakref.ref(ctx), out)
         return [self._drain_cached(p) for p in out]
 

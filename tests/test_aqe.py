@@ -1,0 +1,251 @@
+"""Runtime AQE tests: byte-based coalescing targets, shuffled->broadcast
+join replan, skew split (GpuCustomShuffleReaderExec +
+AQE OptimizeShuffledHashJoin / OptimizeSkewedJoin roles,
+GpuOverrides.scala:1873-1881)."""
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.dataframe import Column
+from spark_rapids_tpu.exprs.aggregates import Count, Sum
+from spark_rapids_tpu.exprs.base import Alias, ColumnRef
+
+from compare import _canon, cpu_session, tpu_session
+
+NO_COLLAPSE = {"spark.rapids.sql.tpu.exchange.collapseLocal": False}
+
+
+def _assert_equal_rows(cpu_rows, tpu_rows):
+    a = _canon(cpu_rows, True, True)
+    b = _canon(tpu_rows, True, True)
+    assert len(a) == len(b), f"cpu={len(a)} tpu={len(b)}"
+    for i, (ra, rb) in enumerate(zip(a, b)):
+        assert ra == rb, f"row {i}: cpu={ra} tpu={rb}"
+
+
+def _metric_ops(sess, name):
+    return [op for op, ms in sess.last_metrics.items()
+            if isinstance(ms, dict) and name in ms]
+
+
+BIG = {
+    "a": (T.INT, [i % 7 for i in range(200)]),
+    "v": (T.LONG, list(range(200))),
+}
+SMALL = {
+    "a": (T.INT, [0, 1, 2, 3, 4, 5, 6, 0, 1, 2]),
+    "w": (T.LONG, [10, 20, 30, 40, 50, 60, 70, 80, 90, 100]),
+}
+
+
+def _replan_query(s, how="inner", small_data=None):
+    # BOTH join inputs are aggregate outputs: plan-time size estimates are
+    # None -> shuffled hash join at plan time; runtime shuffle stats show
+    # a tiny build side -> AQE replans to the broadcast shape
+    big = s.create_dataframe(BIG, num_partitions=3) \
+        .group_by("a", "v").agg(
+            Column(Alias(Count(ColumnRef("v")), "c")))
+    small = s.create_dataframe(small_data or SMALL, num_partitions=2) \
+        .group_by("a").agg(Column(Alias(Sum(ColumnRef("w")), "sw")))
+    return big.join(small, on="a", how=how)
+
+
+def test_aqe_replan_shuffled_to_broadcast():
+    cpu = cpu_session(**NO_COLLAPSE)
+    tpu = tpu_session(**NO_COLLAPSE)
+    cpu_rows = _replan_query(cpu).collect()
+    tpu_rows = _replan_query(tpu).collect()
+    _assert_equal_rows(cpu_rows, tpu_rows)
+    assert "TpuShuffledHashJoin" in tpu.last_physical_plan.tree_string()
+    assert _metric_ops(tpu, "replannedBroadcast"), \
+        f"replan did not fire: {tpu.last_metrics}"
+
+
+def test_aqe_replan_respects_disable_conf():
+    tpu = tpu_session(**dict(
+        NO_COLLAPSE,
+        **{"spark.rapids.sql.adaptive.replanJoins.enabled": False}))
+    rows = _replan_query(tpu).collect()
+    cpu_rows = _replan_query(cpu_session(**NO_COLLAPSE)).collect()
+    _assert_equal_rows(cpu_rows, rows)
+    assert not _metric_ops(tpu, "replannedBroadcast")
+
+
+def test_aqe_replan_left_join_keeps_unmatched():
+    small = {"a": (T.INT, [0, 1]), "w": (T.LONG, [5, 6])}
+    cpu = cpu_session(**NO_COLLAPSE)
+    tpu = tpu_session(**NO_COLLAPSE)
+    _assert_equal_rows(
+        _replan_query(cpu, how="left", small_data=small).collect(),
+        _replan_query(tpu, how="left", small_data=small).collect())
+    assert _metric_ops(tpu, "replannedBroadcast"), tpu.last_metrics
+
+
+def _skew_data():
+    # one dominant key: hash partitioning lands ~all rows in one shuffle
+    # partition, far above the median partition size
+    keys = [42] * 600 + [i for i in range(20)]
+    return {
+        "k": (T.INT, keys),
+        "v": (T.LONG, list(range(len(keys)))),
+    }
+
+
+def test_aqe_skew_split_inner_join():
+    confs = dict(NO_COLLAPSE, **{
+        # tiny byte target so the dominant partition splits into chunks
+        "spark.rapids.sql.adaptive.advisoryPartitionSizeInBytes": 512,
+        "spark.rapids.sql.adaptive.skewJoin.skewedPartitionFactor": 2.0,
+        "spark.sql.autoBroadcastJoinThreshold": -1,
+    })
+
+    def q(s):
+        left = s.create_dataframe(_skew_data(), num_partitions=3)
+        right = s.create_dataframe(
+            {"k": (T.INT, [42, 1, 2, 3]),
+             "w": (T.LONG, [7, 8, 9, 10])},
+            num_partitions=2)
+        return left.join(right, on="k", how="inner")
+
+    cpu = cpu_session(**confs)
+    tpu = tpu_session(**confs)
+    _assert_equal_rows(q(cpu).collect(), q(tpu).collect())
+    ops = _metric_ops(tpu, "skewSplitChunks")
+    assert ops, f"skew split did not fire: {tpu.last_metrics}"
+    chunks = sum(tpu.last_metrics[op]["skewSplitChunks"] for op in ops)
+    assert chunks >= 2, tpu.last_metrics
+
+
+def test_aqe_skew_split_left_join_null_padding():
+    confs = dict(NO_COLLAPSE, **{
+        "spark.rapids.sql.adaptive.advisoryPartitionSizeInBytes": 512,
+        "spark.rapids.sql.adaptive.skewJoin.skewedPartitionFactor": 2.0,
+        "spark.sql.autoBroadcastJoinThreshold": -1,
+    })
+
+    def q(s):
+        left = s.create_dataframe(_skew_data(), num_partitions=3)
+        right = s.create_dataframe(
+            {"k": (T.INT, [42, 99]), "w": (T.LONG, [7, 8])},
+            num_partitions=2)
+        return left.join(right, on="k", how="left")
+
+    cpu = cpu_session(**confs)
+    tpu = tpu_session(**confs)
+    _assert_equal_rows(q(cpu).collect(), q(tpu).collect())
+    assert _metric_ops(tpu, "skewSplitChunks"), tpu.last_metrics
+
+
+def test_aqe_skew_split_single_piece():
+    """A skewed partition that arrives as ONE piece still splits — the
+    chunking is row-granularity, not piece-granularity."""
+    confs = dict(NO_COLLAPSE, **{
+        "spark.rapids.sql.adaptive.advisoryPartitionSizeInBytes": 512,
+        "spark.rapids.sql.adaptive.skewJoin.skewedPartitionFactor": 2.0,
+        "spark.sql.autoBroadcastJoinThreshold": -1,
+    })
+
+    def q(s):
+        left = s.create_dataframe(_skew_data(), num_partitions=1)
+        right = s.create_dataframe(
+            {"k": (T.INT, [42, 1, 2, 3]),
+             "w": (T.LONG, [7, 8, 9, 10])},
+            num_partitions=1)
+        return left.join(right, on="k", how="inner")
+
+    cpu = cpu_session(**confs)
+    tpu = tpu_session(**confs)
+    _assert_equal_rows(q(cpu).collect(), q(tpu).collect())
+    ops = _metric_ops(tpu, "skewSplitChunks")
+    assert ops, f"skew split did not fire: {tpu.last_metrics}"
+    chunks = sum(tpu.last_metrics[op]["skewSplitChunks"] for op in ops)
+    assert chunks >= 2, tpu.last_metrics
+
+
+def test_aqe_skew_split_median_zero():
+    """Extreme skew: ONE hot key, most shuffle partitions empty, median
+    pair size 0 — the hot partition must still be flagged and split."""
+    confs = dict(NO_COLLAPSE, **{
+        "spark.rapids.sql.adaptive.advisoryPartitionSizeInBytes": 512,
+        "spark.sql.autoBroadcastJoinThreshold": -1,
+    })
+
+    def q(s):
+        left = s.create_dataframe(
+            {"k": (T.INT, [42] * 500),
+             "v": (T.LONG, list(range(500)))}, num_partitions=2)
+        right = s.create_dataframe(
+            {"k": (T.INT, [42]), "w": (T.LONG, [7])}, num_partitions=1)
+        return left.join(right, on="k", how="inner")
+
+    cpu = cpu_session(**confs)
+    tpu = tpu_session(**confs)
+    _assert_equal_rows(q(cpu).collect(), q(tpu).collect())
+    ops = _metric_ops(tpu, "skewSplitChunks")
+    assert ops, f"skew split did not fire: {tpu.last_metrics}"
+    chunks = sum(tpu.last_metrics[op]["skewSplitChunks"] for op in ops)
+    assert chunks >= 2, tpu.last_metrics
+
+
+def test_non_collapsed_exchange_array_and_string_columns():
+    """Array + string columns through the device partition split: the
+    split's varlen buffer caps align positionally with gather_rows'
+    varlen columns (a string-only caps list would mis-size the array
+    buffer)."""
+    arr = T.ArrayType(T.LONG)
+    data = {
+        "k": (T.INT, [1, 2, 3, 1, 2, 3, 1, 2]),
+        "arr": (arr, [[1, 2, 3], [], [4], None, [5, 6], [7], [8, 9], []]),
+        "s": (T.STRING, ["aa", "b", None, "dddd", "e", "ff", "g", "hh"]),
+    }
+
+    def q(s):
+        return s.create_dataframe(data, num_partitions=3).order_by("k")
+
+    cpu = cpu_session(**NO_COLLAPSE)
+    tpu = tpu_session(**NO_COLLAPSE)
+    _assert_equal_rows(q(cpu).collect(), q(tpu).collect())
+
+
+def test_aqe_part_stats_prefer_bytes():
+    """Byte stats win over row stats when the exchange recorded both (the
+    reference coalesces by map-status bytes — row targets are an order of
+    magnitude off for wide rows)."""
+    from spark_rapids_tpu.ops.tpu_exec import (
+        _aqe_part_stats, _group_by_target,
+    )
+
+    class FakeExchange:
+        _last_part_rows = [10, 10, 10]
+        _last_part_bytes = [100, 90_000_000, 100]
+
+    sizes, unit = _aqe_part_stats(FakeExchange(), 3)
+    assert unit == "bytes" and sizes == [100, 90_000_000, 100]
+    # a 64MB byte target keeps the fat partition alone; a row target of
+    # 64K would have merged all three
+    groups = _group_by_target(["p0", "p1", "p2"], sizes, 64 << 20)
+    assert ["p0", "p1"] in groups and ["p2"] in groups
+
+    class RowsOnly:
+        _last_part_rows = [10, 10, 10]
+
+    sizes, unit = _aqe_part_stats(RowsOnly(), 3)
+    assert unit == "rows" and sizes == [10, 10, 10]
+    assert _aqe_part_stats(object(), 3) == (None, None)
+
+
+def test_exchange_records_piece_bytes():
+    tpu = tpu_session(**NO_COLLAPSE)
+    df = tpu.create_dataframe(BIG, num_partitions=2)
+    df.group_by("a").agg(
+        Column(Alias(Count(ColumnRef("v")), "c"))).collect()
+    plan = tpu.last_physical_plan
+    found = []
+
+    def walk(node):
+        if hasattr(node, "_last_part_bytes"):
+            found.append(node._last_part_bytes)
+        for c in getattr(node, "children", []):
+            walk(c)
+
+    walk(plan)
+    assert found and all(
+        all(b >= 0 for b in bl) and sum(bl) > 0 for bl in found), found
