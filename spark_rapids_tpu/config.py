@@ -289,6 +289,11 @@ AQE_SKEW_FACTOR = conf_float(
     "exceeds this multiple of the median partition size (and the "
     "advisory target); the stream side is then joined in bounded chunks "
     "against the full build side.")
+NLJ_PAIR_CAPACITY = conf_int(
+    "spark.rapids.sql.nestedLoopJoin.pairCapacity", 1 << 22,
+    "Max cross-pair slots a single nested-loop-join step may allocate; "
+    "a stream side whose pair space exceeds this is joined in row chunks "
+    "(the reference streams broadcast NLJ per stream batch).")
 CSV_ENABLED = conf_bool(
     "spark.rapids.sql.format.csv.enabled", True,
     "Enable TPU-accelerated CSV scans.")

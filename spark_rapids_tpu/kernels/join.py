@@ -357,12 +357,10 @@ def cross_join(left: ColumnBatch, right: ColumnBatch,
     return nested_loop_join(left, right, "cross", None, out_schema)
 
 
-def nested_loop_join(left: ColumnBatch, right: ColumnBatch, join_type: str,
-                     condition, out_schema: T.Schema) -> ColumnBatch:
-    """All-pairs join with an optional condition — every join type
-    (GpuBroadcastNestedLoopJoinExec.scala:305: the reference runs outer /
-    semi NLJ on device too).  Matched pairs = cross pairs passing the
-    condition; unmatched rows null-pad per the join type."""
+def _cross_pairs(left: ColumnBatch, right: ColumnBatch, condition):
+    """All-pairs index arrays (optionally condition-filtered):
+    (l_idx, r_idx, n_pairs, l_counts, r_matched).  Pair capacity is
+    n_l * n_r — callers bound it by chunking the left side."""
     l_cap, r_cap = left.capacity, right.capacity
     n_l = int(jax.device_get(left.num_rows))
     n_r = int(jax.device_get(right.num_rows))
@@ -375,12 +373,55 @@ def nested_loop_join(left: ColumnBatch, right: ColumnBatch, join_type: str,
     l_live = jnp.arange(l_cap, dtype=jnp.int32) < left.num_rows
     r_live = jnp.arange(r_cap, dtype=jnp.int32) < right.num_rows
     if condition is not None:
-        li, ri, n_pairs, l_counts, r_matched = _filter_pairs(
-            left, right, li, ri, n_pairs, condition)
-    else:
-        l_counts = jnp.where(l_live, n_r, 0).astype(jnp.int32)
-        r_matched = r_live & (n_l > 0)
+        return _filter_pairs(left, right, li, ri, n_pairs, condition)
+    l_counts = jnp.where(l_live, n_r, 0).astype(jnp.int32)
+    r_matched = r_live & (n_l > 0)
+    return li, ri, n_pairs, l_counts, r_matched
+
+
+def nested_loop_join(left: ColumnBatch, right: ColumnBatch, join_type: str,
+                     condition, out_schema: T.Schema) -> ColumnBatch:
+    """All-pairs join with an optional condition — every join type
+    (GpuBroadcastNestedLoopJoinExec.scala:305: the reference runs outer /
+    semi NLJ on device too).  Matched pairs = cross pairs passing the
+    condition; unmatched rows null-pad per the join type."""
+    li, ri, n_pairs, l_counts, r_matched = _cross_pairs(
+        left, right, condition)
     if join_type == "cross":
         join_type = "inner"
     return stitch_join_output(left, right, li, ri, n_pairs, l_counts,
                               r_matched, join_type, out_schema)
+
+
+def nested_loop_join_streamed(left_chunks, left_empty: ColumnBatch,
+                              right: ColumnBatch, join_type: str,
+                              condition, out_schema: T.Schema):
+    """right/full NLJ with the left side STREAMED in bounded chunks (the
+    reference streams broadcast NLJ per stream batch,
+    GpuBroadcastNestedLoopJoinExec.scala:305) — no n_l*n_r pair-space
+    allocation.  Right-unmatched rows are a property of the WHOLE left
+    side, so matched flags accumulate across chunks and the
+    left-NULL-padded remainder is emitted once at the end.
+
+    ``left_empty`` is an empty batch of the left schema used for the final
+    right-unmatched emission (also correct when ``left_chunks`` is empty).
+    Yields one batch per chunk plus the final remainder batch."""
+    assert join_type in ("right", "full"), join_type
+    r_cap = right.capacity
+    acc = jnp.zeros(r_cap, dtype=jnp.bool_)
+    # per-chunk: matched pairs (+ left-unmatched padding for 'full' —
+    # left rows belong to exactly one chunk, right is fully present)
+    per_chunk = "inner" if join_type == "right" else "left"
+    for lb in left_chunks:
+        li, ri, n_pairs, l_counts, r_matched = _cross_pairs(
+            lb, right, condition)
+        acc = acc | r_matched
+        yield stitch_join_output(lb, right, li, ri, n_pairs, l_counts,
+                                 r_matched, per_chunk, out_schema)
+    pair1 = round_up_capacity(1)
+    zero_idx = jnp.zeros(pair1, jnp.int32)
+    yield stitch_join_output(
+        left_empty, right, zero_idx, zero_idx,
+        jnp.asarray(0, jnp.int32),
+        jnp.zeros(left_empty.capacity, jnp.int32), acc, "right",
+        out_schema)

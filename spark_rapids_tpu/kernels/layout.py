@@ -16,7 +16,9 @@ import jax
 import jax.numpy as jnp
 
 from spark_rapids_tpu import types as T
-from spark_rapids_tpu.batch import ColumnBatch, DeviceColumn
+from spark_rapids_tpu.batch import (
+    ColumnBatch, DeviceColumn, round_up_capacity,
+)
 
 
 def _string_lengths(col: DeviceColumn):
@@ -83,6 +85,25 @@ def gather_rows(batch: ColumnBatch, indices, num_rows,
             cols.append(DeviceColumn(col.dtype, data, validity, None))
     return ColumnBatch(batch.schema, cols, jnp.asarray(num_rows, jnp.int32),
                        out_cap)
+
+
+def row_slices(batch: ColumnBatch, total_rows: int, rows_per: int):
+    """Yield right-sized row-range slices of ``batch``, ``rows_per`` rows
+    each.  ONE host round trip sizes every slice's varlen buffers from the
+    offsets; slices past ``total_rows`` are not produced."""
+    bounds = list(range(0, total_rows, max(rows_per, 1))) + [total_rows]
+    varlen = [c for c in batch.columns if c.is_varlen]
+    marks = jax.device_get(
+        [c.offsets[jnp.asarray(bounds, jnp.int32)] for c in varlen]) \
+        if varlen else []
+    for i in range(len(bounds) - 1):
+        start, cnt = bounds[i], bounds[i + 1] - bounds[i]
+        pcap = round_up_capacity(cnt)
+        idx = start + jnp.arange(pcap, dtype=jnp.int32)
+        bcaps = [round_up_capacity(max(int(m[i + 1] - m[i]), 16),
+                                   minimum=16) for m in marks]
+        yield gather_rows(batch, idx, jnp.asarray(cnt, jnp.int32),
+                          out_capacity=pcap, out_byte_caps=bcaps or None)
 
 
 def compaction_indices(mask, num_rows):

@@ -883,6 +883,12 @@ class TpuShuffledHashJoinExec(TpuExec):
         bbs = [b for p in build_parts for b in p]
         _reserve_for(ctx, bbs)
         bc = _concat_all(bbs, build_schema)
+        bh = None
+        if bc is not None:
+            from spark_rapids_tpu.runtime.device import DeviceRuntime
+            bh = DeviceRuntime.get(ctx.conf).catalog.register(bc)
+            ctx.defer_close(bh)
+            del bc
         ctx.metric(self.op_id, "replannedBroadcast").add(1)
 
         def gen(part):
@@ -891,7 +897,8 @@ class TpuShuffledHashJoinExec(TpuExec):
                 return
             _reserve_for(ctx, sbs)
             sb = _concat_all(sbs, stream_schema)
-            lb, rb = (sb, bc) if side == "right" else (bc, sb)
+            b = bh.get() if bh is not None else None
+            lb, rb = (sb, b) if side == "right" else (b, sb)
             out = self._join_pair(lb, rb)
             if out is not None:
                 yield out
@@ -930,25 +937,14 @@ class TpuShuffledHashJoinExec(TpuExec):
         total_bytes = total_rows * fixed_row_bytes(stream_b.schema) + \
             sum(t * s for t, s in
                 zip(vtotals, varlen_byte_scales(stream_b.schema)))
+        from spark_rapids_tpu.kernels.layout import row_slices
         target = max(_aqe_target_bytes(ctx), 1)
         n_chunks = max(1, min(max(total_rows, 1),
                               -(-total_bytes // target)))
         rows_per = -(-max(total_rows, 1) // n_chunks)
-        bounds = list(range(0, total_rows, rows_per)) + [total_rows]
-        varlen = [c for c in stream_b.columns if c.is_varlen]
-        marks = jax.device_get(
-            [c.offsets[jnp.asarray(bounds, jnp.int32)] for c in varlen]) \
-            if varlen else []
-        ctx.metric(self.op_id, "skewSplitChunks").add(len(bounds) - 1)
-        for i in range(len(bounds) - 1):
-            start, cnt = bounds[i], bounds[i + 1] - bounds[i]
-            pcap = round_up_capacity(cnt)
-            idx = start + jnp.arange(pcap, dtype=jnp.int32)
-            bcaps = [round_up_capacity(max(int(m[i + 1] - m[i]), 16),
-                                       minimum=16) for m in marks]
-            sb = gather_rows(stream_b, idx, jnp.asarray(cnt, jnp.int32),
-                             out_capacity=pcap,
-                             out_byte_caps=bcaps or None)
+        ctx.metric(self.op_id, "skewSplitChunks").add(
+            -(-total_rows // rows_per) if total_rows else 0)
+        for sb in row_slices(stream_b, total_rows, rows_per):
             lb, rb = (sb, build_b) if split_left else (build_b, sb)
             out = self._join_pair(lb, rb)
             if out is not None:
@@ -1000,33 +996,76 @@ class TpuNestedLoopJoinExec(TpuExec):
         return self.children[0].num_partitions(ctx)
 
     def partitions(self, ctx):
-        from spark_rapids_tpu.kernels.join import nested_loop_join
+        from spark_rapids_tpu.config import NLJ_PAIR_CAPACITY
+        from spark_rapids_tpu.kernels.join import (
+            nested_loop_join, nested_loop_join_streamed,
+        )
+        from spark_rapids_tpu.kernels.layout import row_slices
+        from spark_rapids_tpu.runtime.device import DeviceRuntime
+        budget = max(NLJ_PAIR_CAPACITY.get(ctx.conf), 1)
+        lsch = self.children[0].output_schema
+        rsch = self.children[1].output_schema
         rbatches = []
         for p in self.children[1].partitions(ctx):
             rbatches.extend(p)
-        rb = _concat_all(rbatches, self.children[1].output_schema)
+        rb = _concat_all(rbatches, rsch)
+        # The broadcast-materialized side lives in the spill catalog (the
+        # reference registers broadcast tables with the buffer catalog) —
+        # evictable under memory pressure, re-fetched per use.
+        rh = None
+        n_r = 0
+        if rb is not None:
+            n_r = rb.host_num_rows()
+            catalog = DeviceRuntime.get(ctx.conf).catalog
+            rh = catalog.register(rb)
+            ctx.defer_close(rh)
+            del rb
+
+        def rb_local():
+            return rh.get() if rh is not None else empty_device_batch(rsch)
+
         lparts = self.children[0].partitions(ctx)
-        lsch = self.children[0].output_schema
-        rsch = self.children[1].output_schema
+        rows_per = max(1, budget // max(n_r, 1))
 
         if self.how in ("right", "full"):
             # right-unmatched rows are a property of the WHOLE left side:
-            # run one global all-pairs join
+            # stream left chunks against the full right, accumulating
+            # right-matched flags; remainder emitted at the end
             def gen_all():
                 lbatches = [b for p in lparts for b in p]
+                _reserve_for(ctx, lbatches)
                 lb = _concat_all(lbatches, lsch) or empty_device_batch(lsch)
-                rb_local = rb if rb is not None else \
-                    empty_device_batch(rsch)
-                yield nested_loop_join(lb, rb_local, self.how,
-                                       self.condition, self.output_schema)
+                r = rb_local()
+                n_l = lb.host_num_rows()
+                if n_l * max(n_r, 1) <= budget:
+                    yield nested_loop_join(lb, r, self.how, self.condition,
+                                           self.output_schema)
+                    return
+                ctx.metric(self.op_id, "nljChunks").add(
+                    -(-n_l // rows_per))
+                yield from nested_loop_join_streamed(
+                    row_slices(lb, n_l, rows_per),
+                    empty_device_batch(lsch), r, self.how, self.condition,
+                    self.output_schema)
 
             return [gen_all()]
 
         def gen(lp):
-            rb_local = rb if rb is not None else empty_device_batch(rsch)
             for lb in lp:
-                yield nested_loop_join(lb, rb_local, self.how,
-                                       self.condition, self.output_schema)
+                r = rb_local()
+                n_l = lb.host_num_rows()
+                if n_l * max(n_r, 1) <= budget:
+                    yield nested_loop_join(lb, r, self.how, self.condition,
+                                           self.output_schema)
+                    continue
+                # inner/left/semi/anti: each left row's outcome only needs
+                # the FULL right side — chunking the left is exact
+                ctx.metric(self.op_id, "nljChunks").add(
+                    -(-n_l // rows_per))
+                for chunk in row_slices(lb, n_l, rows_per):
+                    yield nested_loop_join(chunk, r, self.how,
+                                           self.condition,
+                                           self.output_schema)
 
         return [gen(p) for p in lparts]
 
@@ -1150,7 +1189,7 @@ class TpuBroadcastHashJoinExec(TpuExec):
         self.how = how
         self.broadcast_side = broadcast_side
         self.condition = condition
-        self._bc: Optional[ColumnBatch] = None
+        self._bc_cache = None  # (weakref(ctx), SpillableBatch | None)
 
     def describe(self):
         return f"TpuBroadcastHashJoin({self.how}, bc={self.broadcast_side})"
@@ -1158,27 +1197,42 @@ class TpuBroadcastHashJoinExec(TpuExec):
     def num_partitions(self, ctx):
         return self.children[0].num_partitions(ctx)
 
-    def _broadcast_batch(self, ctx) -> Optional[ColumnBatch]:
-        if self._bc is None:
-            batches = []
-            for p in self.children[1].partitions(ctx):
-                batches.extend(p)
-            self._bc = _concat_all(batches,
-                                   self.children[1].output_schema)
-        return self._bc
+    def _broadcast_handle(self, ctx):
+        """Materialize the build side ONCE per query and register it with
+        the spill catalog (the reference keeps broadcast build batches in
+        the buffer catalog, spillable like everything else — an
+        unregistered cached build side would be un-evictable HBM).  The
+        handle is ctx-scoped (weakref, like the exchange's split cache)
+        and defer-closed, so a finished query's build side leaves the
+        catalog instead of pinning device budget and spill files."""
+        import weakref
+        cached = self._bc_cache
+        if cached is not None and cached[0]() is ctx:
+            return cached[1]
+        batches = []
+        for p in self.children[1].partitions(ctx):
+            batches.extend(p)
+        bc = _concat_all(batches, self.children[1].output_schema)
+        handle = None
+        if bc is not None:
+            from spark_rapids_tpu.runtime.device import DeviceRuntime
+            catalog = DeviceRuntime.get(ctx.conf).catalog
+            handle = catalog.register(bc)
+            ctx.defer_close(handle)
+        self._bc_cache = (weakref.ref(ctx), handle)
+        return handle
 
     def partitions(self, ctx):
-        bc = self._broadcast_batch(ctx)
+        bh = self._broadcast_handle(ctx)
         bc_schema = self.children[1].output_schema
         stream_schema = self.children[0].output_schema
 
         def gen(part):
-            nonlocal bc
             for sb in part:
-                if bc is None:
-                    bc_local = empty_device_batch(bc_schema)
-                else:
-                    bc_local = bc
+                # re-fetch per stream batch: a spilled build side frees
+                # real HBM between batches and unspills on demand
+                bc_local = bh.get() if bh is not None else \
+                    empty_device_batch(bc_schema)
                 if self.broadcast_side == "right":
                     lb, rb = sb, bc_local
                 else:

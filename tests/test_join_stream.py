@@ -1,0 +1,136 @@
+"""Streamed/chunked nested-loop join + spillable broadcast build side
+(GpuBroadcastNestedLoopJoinExec.scala:305 streaming shape; broadcast
+build batches registered with the buffer catalog)."""
+
+from spark_rapids_tpu import types as T
+
+from compare import _canon, cpu_session, tpu_session
+
+SMALL_PAIRS = {"spark.rapids.sql.nestedLoopJoin.pairCapacity": 4096}
+
+
+def _assert_equal_rows(cpu_rows, tpu_rows):
+    a = _canon(cpu_rows, True, True)
+    b = _canon(tpu_rows, True, True)
+    assert len(a) == len(b), f"cpu={len(a)} tpu={len(b)}"
+    for i, (ra, rb) in enumerate(zip(a, b)):
+        assert ra == rb, f"row {i}: cpu={ra} tpu={rb}"
+
+
+def _metric_ops(sess, name):
+    return [op for op, ms in sess.last_metrics.items()
+            if isinstance(ms, dict) and name in ms]
+
+
+N_LEFT = 5000
+
+
+def _left(s, parts=2):
+    return s.create_dataframe(
+        {"a": (T.INT, [i % 97 for i in range(N_LEFT)]),
+         "v": (T.LONG, list(range(N_LEFT)))}, num_partitions=parts)
+
+
+def _right(s):
+    return s.create_dataframe(
+        {"k": (T.INT, [10, 50, 96, 200]),
+         "w": (T.LONG, [1, 2, 3, 4])}, num_partitions=1)
+
+
+def _nlj(s, how):
+    left, right = _left(s), _right(s)
+    return left.join(right, on=left["a"] < right["k"], how=how)
+
+
+def test_nlj_right_join_chunked():
+    """Left side far above the pair budget: the right join streams left
+    chunks and the pair space stays bounded (no n_l*n_r allocation)."""
+    cpu = cpu_session(**SMALL_PAIRS)
+    tpu = tpu_session(**SMALL_PAIRS)
+    _assert_equal_rows(_nlj(cpu, "right").collect(),
+                       _nlj(tpu, "right").collect())
+    ops = _metric_ops(tpu, "nljChunks")
+    assert ops, f"chunking did not fire: {tpu.last_metrics}"
+    assert sum(tpu.last_metrics[op]["nljChunks"] for op in ops) >= 2
+
+
+def test_nlj_full_join_chunked():
+    cpu = cpu_session(**SMALL_PAIRS)
+    tpu = tpu_session(**SMALL_PAIRS)
+    _assert_equal_rows(_nlj(cpu, "full").collect(),
+                       _nlj(tpu, "full").collect())
+    assert _metric_ops(tpu, "nljChunks"), tpu.last_metrics
+
+
+def test_nlj_right_join_no_matches_all_padded():
+    """Right rows that match nothing across EVERY left chunk come back
+    exactly once, left-NULL-padded."""
+    def q(s):
+        left = s.create_dataframe(
+            {"a": (T.INT, list(range(3000)))}, num_partitions=2)
+        right = s.create_dataframe(
+            {"k": (T.INT, [-1, -2])}, num_partitions=1)
+        return left.join(right, on=left["a"] < right["k"], how="right")
+
+    cpu = cpu_session(**SMALL_PAIRS)
+    tpu = tpu_session(**SMALL_PAIRS)
+    _assert_equal_rows(q(cpu).collect(), q(tpu).collect())
+
+
+def test_nlj_left_join_chunked():
+    cpu = cpu_session(**SMALL_PAIRS)
+    tpu = tpu_session(**SMALL_PAIRS)
+    _assert_equal_rows(_nlj(cpu, "left").collect(),
+                       _nlj(tpu, "left").collect())
+    assert _metric_ops(tpu, "nljChunks"), tpu.last_metrics
+
+
+def test_nlj_cross_join_chunked_with_strings():
+    def q(s):
+        left = s.create_dataframe(
+            {"a": (T.INT, list(range(3000))),
+             "s": (T.STRING, [f"row{i}" for i in range(3000)])},
+            num_partitions=2)
+        right = s.create_dataframe(
+            {"w": (T.LONG, [1, 2, 3])}, num_partitions=1)
+        return left.join(right, on=None, how="cross")
+
+    cpu = cpu_session(**SMALL_PAIRS)
+    tpu = tpu_session(**SMALL_PAIRS)
+    _assert_equal_rows(q(cpu).collect(), q(tpu).collect())
+    assert _metric_ops(tpu, "nljChunks"), tpu.last_metrics
+
+
+def test_broadcast_build_side_registered_spillable():
+    """The broadcast hash join's cached build side lives in the spill
+    catalog (evictable), not as a pinned exec-node attribute."""
+    from spark_rapids_tpu.ops.tpu_exec import TpuBroadcastHashJoinExec
+
+    s = tpu_session()
+    big = s.create_dataframe(
+        {"a": (T.INT, [i % 5 for i in range(100)]),
+         "v": (T.LONG, list(range(100)))}, num_partitions=2)
+    small = s.create_dataframe(
+        {"a": (T.INT, [0, 1, 2]), "w": (T.LONG, [7, 8, 9])},
+        num_partitions=1)
+    rows = big.join(small, on="a", how="inner").collect()
+    assert len(rows) == 60
+
+    found = []
+
+    def walk(node):
+        if isinstance(node, TpuBroadcastHashJoinExec):
+            found.append(node)
+        for c in getattr(node, "children", []):
+            walk(c)
+
+    walk(s.last_physical_plan)
+    assert found, s.last_physical_plan.tree_string()
+    cached = found[0]._bc_cache
+    assert cached is not None
+    h = cached[1]
+    # registered with the catalog during the query, defer-closed when the
+    # query ended: spillable while live, NOT leaked afterwards
+    assert h is not None and h.closed
+    again = big.join(small, on="a", how="inner").collect()
+    assert len(again) == 60
