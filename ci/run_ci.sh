@@ -42,4 +42,7 @@ g.dryrun_multichip(8)
 print("dryrun ok")
 PY
 
+echo "== two-process multi-host dryrun (2 x 4 virtual CPU devices)"
+python -m pytest tests/test_multihost.py -q
+
 echo "CI PASSED"

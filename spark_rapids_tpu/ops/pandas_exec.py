@@ -9,11 +9,12 @@ Reference analogues (sql-plugin/.../execution/python/):
 * GpuWindowInPandasExec — :class:`CpuWindowInPandasExec`
 
 Like the reference, the engine side of these ops is data movement: device
-batches come back to host columnar form, python runs under the
-PythonWorkerSemaphore analogue (device semaphore released meanwhile), and
-results stage back to HBM via the planner's automatic transitions.  Python
-itself runs in-process (no out-of-process worker protocol; the semaphore
-plays that role — runtime/python_worker.py).
+batches come back to host columnar form, user python runs OUT OF PROCESS
+in a forked worker streaming framed batches over pipes
+(GpuArrowPythonRunner / python/rapids/worker.py analogue —
+runtime/python_worker.py), bounded by the PythonWorkerSemaphore analogue
+with the device semaphore released meanwhile, and results stage back to
+HBM via the planner's automatic transitions.
 """
 
 from __future__ import annotations
@@ -25,7 +26,9 @@ import numpy as np
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.batch import HostBatch, HostColumn
 from spark_rapids_tpu.plan.physical import CpuExec, ExecContext, PhysicalOp
-from spark_rapids_tpu.runtime.python_worker import python_worker_slot
+from spark_rapids_tpu.runtime.python_worker import (
+    run_python_task, run_single_input_task,
+)
 
 
 def _to_pandas(hb: HostBatch):
@@ -66,16 +69,23 @@ class CpuMapInPandasExec(CpuExec):
         return "CpuMapInPandas"
 
     def partitions(self, ctx: ExecContext):
-        def gen(part):
+        in_schema = self.children[0].output_schema
+        out_schema = self.output_schema
+        fn = self.fn
+
+        def task(frames):  # runs in the worker process
             def pdf_iter():
-                for hb in part:
+                for _i, hb in frames:
                     yield _to_pandas(hb)
 
-            with python_worker_slot(ctx):
-                for pdf in self.fn(pdf_iter()):
-                    hb = pandas_to_host_batch(pdf, self.output_schema)
-                    if hb.num_rows:
-                        yield hb
+            for pdf in fn(pdf_iter()):
+                hb = pandas_to_host_batch(pdf, out_schema)
+                if hb.num_rows:
+                    yield hb
+
+        def gen(part):
+            yield from run_single_input_task(ctx, task, part, in_schema,
+                                             out_schema)
 
         return [gen(p) for p in self.children[0].partitions(ctx)]
 
@@ -94,20 +104,23 @@ class CpuFlatMapGroupsInPandasExec(CpuExec):
         return f"CpuFlatMapGroupsInPandas(keys={self.key_names})"
 
     def partitions(self, ctx: ExecContext):
-        def gen(part):
-            batches = list(part)
+        in_schema = self.children[0].output_schema
+        out_schema = self.output_schema
+        fn, key_names = self.fn, self.key_names
+
+        def task(frames):  # runs in the worker process
+            batches = [hb for _i, hb in frames]
             if not batches:
                 return
             pdf = _to_pandas(HostBatch.concat(batches))
-            outs = []
-            with python_worker_slot(ctx):
-                for _k, grp in pdf.groupby(self.key_names, dropna=False,
-                                           sort=True):
-                    outs.append(self.fn(grp))
-            for out in outs:
-                hb = pandas_to_host_batch(out, self.output_schema)
+            for _k, grp in pdf.groupby(key_names, dropna=False, sort=True):
+                hb = pandas_to_host_batch(fn(grp), out_schema)
                 if hb.num_rows:
                     yield hb
+
+        def gen(part):
+            yield from run_single_input_task(ctx, task, part, in_schema,
+                                             out_schema)
 
         return [gen(p) for p in self.children[0].partitions(ctx)]
 
@@ -152,28 +165,40 @@ class CpuFlatMapCoGroupsInPandasExec(CpuExec):
                                                 and p != p)) else p
                          for p in parts)
 
-        def gen(lp, rp):
-            lbs, rbs = list(lp), list(rp)
+        fn = self.fn
+        left_names, right_names = self.left_names, self.right_names
+        out_schema = self.output_schema
+
+        def task(frames):  # runs in the worker process
+            lbs, rbs = [], []
+            for i, hb in frames:
+                (lbs if i == 0 else rbs).append(hb)
             lpdf = _to_pandas(HostBatch.concat(lbs)) if lbs else \
                 empty_pdf(lsch)
             rpdf = _to_pandas(HostBatch.concat(rbs)) if rbs else \
                 empty_pdf(rsch)
             lgroups = {norm_key(k): g for k, g in lpdf.groupby(
-                self.left_names, dropna=False)} if len(lpdf) else {}
+                left_names, dropna=False)} if len(lpdf) else {}
             rgroups = {norm_key(k): g for k, g in rpdf.groupby(
-                self.right_names, dropna=False)} if len(rpdf) else {}
+                right_names, dropna=False)} if len(rpdf) else {}
             keys = sorted(set(lgroups) | set(rgroups),
                           key=lambda k: (str(k),))
-            outs = []
-            with python_worker_slot(ctx):
-                for k in keys:
-                    lg = lgroups.get(k, lpdf.iloc[0:0])
-                    rg = rgroups.get(k, rpdf.iloc[0:0])
-                    outs.append(self.fn(lg, rg))
-            for out in outs:
-                hb = pandas_to_host_batch(out, self.output_schema)
+            for k in keys:
+                lg = lgroups.get(k, lpdf.iloc[0:0])
+                rg = rgroups.get(k, rpdf.iloc[0:0])
+                hb = pandas_to_host_batch(fn(lg, rg), out_schema)
                 if hb.num_rows:
                     yield hb
+
+        def gen(lp, rp):
+            def inputs():
+                for hb in lp:
+                    yield 0, hb
+                for hb in rp:
+                    yield 1, hb
+
+            yield from run_python_task(ctx, task, inputs(),
+                                       [lsch, rsch], out_schema)
 
         return [gen(lp, rp) for lp, rp in zip(lparts, rparts)]
 
@@ -192,27 +217,32 @@ class CpuAggregateInPandasExec(CpuExec):
         return f"CpuAggregateInPandas(keys={self.key_names})"
 
     def partitions(self, ctx: ExecContext):
-        def gen(part):
-            batches = list(part)
+        in_schema = self.children[0].output_schema
+        out_schema = self.output_schema
+        key_names, agg_specs = self.key_names, self.agg_specs
+
+        def task(frames):  # runs in the worker process
+            batches = [hb for _i, hb in frames]
             if not batches:
                 return
             pdf = _to_pandas(HostBatch.concat(batches))
             rows = []
-            with python_worker_slot(ctx):
-                for k, grp in pdf.groupby(self.key_names, dropna=False,
-                                          sort=True):
-                    key_vals = k if isinstance(k, tuple) else (k,)
-                    vals = [fn(grp[col])
-                            for _n, fn, _dt, col in self.agg_specs]
-                    rows.append(tuple(key_vals) + tuple(vals))
+            for k, grp in pdf.groupby(key_names, dropna=False, sort=True):
+                key_vals = k if isinstance(k, tuple) else (k,)
+                vals = [fn(grp[col]) for _n, fn, _dt, col in agg_specs]
+                rows.append(tuple(key_vals) + tuple(vals))
             if not rows:
                 return
             cols = []
-            for i, f in enumerate(self.output_schema.fields):
+            for i, f in enumerate(out_schema.fields):
                 items = [r[i] for r in rows]
                 items = [None if _is_nan(x) else x for x in items]
                 cols.append(HostColumn.from_list(f.dtype, items))
-            yield HostBatch(self.output_schema, cols)
+            yield HostBatch(out_schema, cols)
+
+        def gen(part):
+            yield from run_single_input_task(ctx, task, part, in_schema,
+                                             out_schema)
 
         return [gen(p) for p in self.children[0].partitions(ctx)]
 
@@ -239,19 +269,24 @@ class CpuWindowInPandasExec(CpuExec):
         return f"CpuWindowInPandas(keys={self.key_names})"
 
     def partitions(self, ctx: ExecContext):
-        def gen(part):
-            batches = list(part)
+        in_schema = self.children[0].output_schema
+        out_schema = self.output_schema
+        key_names, win_specs = self.key_names, self.win_specs
+
+        def task(frames):  # runs in the worker process
+            batches = [hb for _i, hb in frames]
             if not batches:
                 return
             pdf = _to_pandas(HostBatch.concat(batches))
-            with python_worker_slot(ctx):
-                grouped = pdf.groupby(self.key_names, dropna=False,
-                                      sort=False)
-                for name, fn, _dt, col in self.win_specs:
-                    pdf[name] = grouped[col].transform(
-                        lambda s, fn=fn: fn(s))
-            hb = pandas_to_host_batch(pdf, self.output_schema)
+            grouped = pdf.groupby(key_names, dropna=False, sort=False)
+            for name, fn, _dt, col in win_specs:
+                pdf[name] = grouped[col].transform(lambda s, fn=fn: fn(s))
+            hb = pandas_to_host_batch(pdf, out_schema)
             if hb.num_rows:
                 yield hb
+
+        def gen(part):
+            yield from run_single_input_task(ctx, task, part, in_schema,
+                                             out_schema)
 
         return [gen(p) for p in self.children[0].partitions(ctx)]

@@ -1,0 +1,133 @@
+"""Two-process (multi-HOST) dryrun of the mesh-shuffled aggregation.
+
+The reference's shuffle transport serves multi-executor as the normal case
+(shuffle-plugin UCXShuffleTransport.scala:47-235 — executors discover each
+other and move shuffle blocks over the wire).  The TPU-first analogue
+needs no custom transport: each host joins the process group via
+``jax.distributed.initialize`` (parallel/multihost.py), the SAME jitted
+SPMD program (partition -> all_to_all -> local merge agg,
+parallel/distributed.py) runs on every process, and XLA's collectives
+carry the bytes — ICI within a slice, DCN (here: Gloo over TCP) across
+hosts.
+
+Run one process per host:
+
+    python -m spark_rapids_tpu.parallel.multihost_demo \
+        --rank 0 --world 2 --coordinator 127.0.0.1:29500 [--devices 4]
+
+Every rank verifies the GLOBAL result against a numpy oracle (outputs are
+gathered with ``process_allgather``) and prints one JSON line.  Exercised
+by tests/test_multihost.py and the CI ``multihost`` step.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rank", type=int, required=True)
+    ap.add_argument("--world", type=int, required=True)
+    ap.add_argument("--coordinator", required=True)
+    ap.add_argument("--devices", type=int, default=4,
+                    help="virtual CPU devices per process")
+    ap.add_argument("--rows", type=int, default=256)
+    args = ap.parse_args(argv)
+
+    # CPU backend with N virtual devices per process — must be set before
+    # jax initializes (the dryrun trick from tests/conftest.py, per host)
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") +
+        f" --xla_force_host_platform_device_count={args.devices}")
+    os.environ.pop("JAX_PLATFORMS", None)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.parallel.multihost import (
+        init_multihost, world_info,
+    )
+
+    conf = RapidsConf({
+        "spark.rapids.multihost.coordinator": args.coordinator,
+        "spark.rapids.multihost.numProcesses": args.world,
+        "spark.rapids.multihost.processId": args.rank,
+    })
+    active = init_multihost(conf)
+    assert active, "multi-host group did not form"
+    info = world_info()
+    assert info["process_count"] == args.world, info
+    assert info["global_devices"] == args.world * args.devices, info
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from spark_rapids_tpu.parallel.distributed import (
+        make_distributed_agg_step,
+    )
+    from spark_rapids_tpu.parallel.mesh_shuffle import DATA_AXIS, make_mesh
+
+    n = info["global_devices"]
+    cap = args.rows
+    n_keys = 17
+    # every rank derives the identical GLOBAL dataset (same seed), then
+    # contributes only its local shards — the multi-controller contract
+    rng = np.random.RandomState(7)
+    keys = rng.randint(0, n_keys, size=(n, cap)).astype(np.int64)
+    values = rng.randint(-100, 100, size=(n, cap)).astype(np.int64)
+    validity = rng.rand(n, cap) < 0.9
+    num_rows = np.full(n, cap, dtype=np.int32)
+    num_rows[-1] = cap // 2  # ragged shard
+
+    mesh = make_mesh(n)
+    s2 = NamedSharding(mesh, P(DATA_AXIS, None))
+    s1 = NamedSharding(mesh, P(DATA_AXIS))
+    lo = args.rank * args.devices
+    hi = lo + args.devices
+
+    def shard2(a):
+        return jax.make_array_from_process_local_data(
+            s2, np.ascontiguousarray(a[lo:hi]), a.shape)
+
+    dk, dv, dva = shard2(keys), shard2(values), shard2(validity)
+    dn = jax.make_array_from_process_local_data(
+        s1, np.ascontiguousarray(num_rows[lo:hi]), num_rows.shape)
+
+    step = make_distributed_agg_step(mesh, cap)
+    gk, gs, ng = jax.block_until_ready(step(dk, dv, dva, dn))
+
+    # gather every process's output shards for global verification
+    gk_h = np.asarray(multihost_utils.process_allgather(gk, tiled=True))
+    gs_h = np.asarray(multihost_utils.process_allgather(gs, tiled=True))
+    ng_h = np.asarray(multihost_utils.process_allgather(ng, tiled=True))
+
+    expect = {}
+    for d in range(n):
+        for r in range(num_rows[d]):
+            k = int(keys[d, r])
+            expect[k] = expect.get(k, 0) + (
+                int(values[d, r]) if validity[d, r] else 0)
+    got = {}
+    for d in range(n):
+        for i in range(int(ng_h[d])):
+            got[int(gk_h[d, i])] = got.get(int(gk_h[d, i]), 0) + \
+                int(gs_h[d, i])
+    assert got == expect, f"rank {args.rank}: {got} != {expect}"
+
+    print(json.dumps({
+        "ok": True, "rank": args.rank,
+        "process_count": info["process_count"],
+        "local_devices": info["local_devices"],
+        "global_devices": info["global_devices"],
+        "groups": len(got), "rows": int(num_rows.sum()),
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,58 @@
+"""Two-process multi-host dryrun: spawns 2 CPU-backend processes that join
+a jax.distributed process group via init_multihost and run the
+mesh-shuffled aggregation across them (the reference's multi-executor
+shuffle as the normal case, UCXShuffleTransport.scala:47-235)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_multihost_agg():
+    port = _free_port()
+    env = dict(os.environ)
+    # the demo pins its own platform/flags; scrub the test harness's
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m",
+             "spark_rapids_tpu.parallel.multihost_demo",
+             "--rank", str(rank), "--world", "2",
+             "--coordinator", f"127.0.0.1:{port}", "--devices", "4"],
+            cwd=repo, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        for rank in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"rank failed:\n{out[-3000:]}"
+    results = []
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("{"):
+                results.append(json.loads(line))
+    assert len(results) == 2, outs
+    for r in results:
+        assert r["ok"] and r["process_count"] == 2
+        assert r["local_devices"] == 4 and r["global_devices"] == 8
+    assert {r["rank"] for r in results} == {0, 1}

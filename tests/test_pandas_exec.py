@@ -203,3 +203,121 @@ def test_cogroup_null_keys_pair_up():
     rows = {r[0]: (r[1], r[2]) for r in out.collect()}
     # the NULL key must appear ONCE with both sides
     assert rows[None] == (1, 1), rows
+
+
+# ---------------------------------------------------------------------------
+# Out-of-process worker (GpuArrowPythonRunner / python/rapids/worker.py
+# analogue): user python runs in a forked process over framed IPC pipes.
+# ---------------------------------------------------------------------------
+
+
+def test_map_in_pandas_runs_out_of_process():
+    import os
+    s = tpu_session()
+    df = s.create_dataframe(DATA, num_partitions=2)
+
+    def fn(it):
+        for pdf in it:
+            pdf = pdf.copy()
+            pdf["pid"] = os.getpid()
+            yield pdf[["k", "pid"]]
+
+    out = df.map_in_pandas(fn, [("k", T.STRING), ("pid", T.LONG)])
+    rows = out.collect()
+    assert rows, "no rows"
+    worker_pids = {r[1] for r in rows}
+    assert os.getpid() not in worker_pids, \
+        f"python ran in the engine process: {worker_pids}"
+    from spark_rapids_tpu.runtime import python_worker
+    assert python_worker.last_worker_pid is not None
+    assert python_worker.last_worker_pid != os.getpid()
+
+
+def test_map_in_pandas_in_process_when_disabled():
+    import os
+    s = tpu_session(**{"spark.rapids.python.outOfProcess.enabled": False})
+    df = s.create_dataframe(DATA, num_partitions=2)
+
+    def fn(it):
+        for pdf in it:
+            pdf = pdf.copy()
+            pdf["pid"] = os.getpid()
+            yield pdf[["k", "pid"]]
+
+    out = df.map_in_pandas(fn, [("k", T.STRING), ("pid", T.LONG)])
+    assert {r[1] for r in out.collect()} == {os.getpid()}
+
+
+def test_worker_crash_raises_and_engine_survives():
+    import os
+
+    import pytest
+
+    from spark_rapids_tpu.runtime.python_worker import PythonWorkerError
+
+    s = tpu_session()
+    df = s.create_dataframe(DATA, num_partitions=1)
+
+    def crash(it):
+        for _pdf in it:
+            os._exit(9)  # hard death: no exception frame reaches the pipe
+        yield  # pragma: no cover
+
+    out = df.map_in_pandas(crash, [("k", T.STRING)])
+    with pytest.raises(PythonWorkerError, match="died"):
+        out.collect()
+
+    # the engine process is intact: a fresh query on the same session works
+    def ok(it):
+        for pdf in it:
+            yield pdf[["k"]]
+
+    rows = s.create_dataframe(DATA, num_partitions=1) \
+        .map_in_pandas(ok, [("k", T.STRING)]).collect()
+    assert len(rows) == 8
+
+
+def test_worker_exception_propagates_with_traceback():
+    import pytest
+
+    from spark_rapids_tpu.runtime.python_worker import PythonWorkerError
+
+    s = tpu_session()
+    df = s.create_dataframe(DATA, num_partitions=1)
+
+    def boom(it):
+        for _pdf in it:
+            raise ValueError("user code exploded")
+        yield  # pragma: no cover
+
+    out = df.map_in_pandas(boom, [("k", T.STRING)])
+    with pytest.raises(PythonWorkerError, match="user code exploded"):
+        out.collect()
+
+
+def test_upstream_error_propagates_through_worker():
+    """An upstream iterator failure (scan/expression) must surface on the
+    consumer, not read as clean EOF + silently truncated results."""
+    import pytest
+
+    from spark_rapids_tpu.batch import HostBatch
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.runtime.python_worker import run_python_task
+
+    class Ctx:
+        conf = RapidsConf()
+        semaphore = None
+
+    hb = HostBatch.from_pydict({"v": (T.LONG, [1, 2, 3])})
+
+    def inputs():
+        yield 0, hb
+        raise ValueError("upstream scan failed")
+
+    def task(frames):
+        for _i, b in frames:
+            yield b
+
+    with pytest.raises(ValueError, match="upstream scan failed"):
+        list(run_python_task(Ctx(), task, inputs(), [hb.schema],
+                             hb.schema))
