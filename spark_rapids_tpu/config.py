@@ -289,6 +289,14 @@ AQE_SKEW_FACTOR = conf_float(
     "exceeds this multiple of the median partition size (and the "
     "advisory target); the stream side is then joined in bounded chunks "
     "against the full build side.")
+HASH_AGG_MXU_ENABLED = conf_bool(
+    "spark.rapids.sql.agg.mxuHash.enabled", True,
+    "Aggregate update batches on the MXU via slot one-hot contractions "
+    "when the agg list is sum/count/avg and the group key is one "
+    "integral/date/bool column: one matmul replaces the sort-based "
+    "groupby's argsort + gathers + scatters.  Batches whose key range "
+    "exceeds the slot table (or float sums over NaN/Inf) transparently "
+    "re-run the exact sort path.")
 NLJ_PAIR_CAPACITY = conf_int(
     "spark.rapids.sql.nestedLoopJoin.pairCapacity", 1 << 22,
     "Max cross-pair slots a single nested-loop-join step may allocate; "

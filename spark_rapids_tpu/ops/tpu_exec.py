@@ -62,6 +62,11 @@ def shrink_to_fit(batch: ColumnBatch,
                        out_capacity=cap, out_byte_caps=byte_caps or None)
 
 
+# trailing pseudo-batch of the hash-agg pipeline stage: num_rows counts
+# collided batches (compared by object identity)
+_HASH_FLAGS_SCHEMA = T.Schema([("__hashagg_flags", T.INT)])
+
+
 def _reserve_for(ctx, batches: List[ColumnBatch], factor: int = 2) -> None:
     """Budget headroom before a large concat/gather: ask the catalog to
     evict lower-priority spillable batches so input + output fit
@@ -526,12 +531,21 @@ class TpuHashAggregateExec(TpuExec):
         ])
         self.buffer_schemas = [[s.dtype for s in a.fn.buffers()]
                                for a in aggs]
+        from spark_rapids_tpu.kernels.hashagg import hash_agg_capable
+        self._hash_capable = hash_agg_capable(
+            mode, [e.dtype for e in key_exprs], [a.fn for a in aggs])
+        self._hash_disabled = False  # sticky off after a collided batch
 
         @jax.jit
         def run(batch: ColumnBatch) -> ColumnBatch:
             return self._aggregate_batch(batch)
 
+        @jax.jit
+        def run_hash(batch: ColumnBatch):
+            return self._aggregate_batch_hash(batch)
+
         self._run = run
+        self._run_hash = run_hash
         self._merge_run = jax.jit(self._merge_partials)
         self._input_fns = []
 
@@ -547,15 +561,35 @@ class TpuHashAggregateExec(TpuExec):
                 batch = f(batch)
             return self._aggregate_batch(batch)
 
+        def run_hash(batch: ColumnBatch):
+            for f in self._input_fns:
+                batch = f(batch)
+            return self._aggregate_batch_hash(batch)
+
         self._run = jax.jit(run)
+        self._run_hash = jax.jit(run_hash)
+
+    def _hash_active(self, ctx) -> bool:
+        from spark_rapids_tpu.config import HASH_AGG_MXU_ENABLED
+        return self._hash_capable and not self._hash_disabled and \
+            HASH_AGG_MXU_ENABLED.get(ctx.conf)
 
     def describe(self):
         return f"TpuHashAggregate({self.mode}, keys={len(self.key_exprs)})"
+
+    def stage_variant(self, ctx) -> str:
+        """Key for the pipeline stage cache: the update stage compiles a
+        hash-path and a sort-path program (the latter built on demand when
+        a collided batch forces the exact fallback)."""
+        if self.mode == "update" and self._hash_active(ctx):
+            return "hash"
+        return "sort"
 
     def pipeline_inline(self, ctx, build):
         from spark_rapids_tpu.plan.pipeline import concat_static
         cf = build(self.children[0])
         child_schema = self.children[0].output_schema
+        use_hash = self.mode == "update" and self._hash_active(ctx)
 
         def f(args):
             batches = cf(args)
@@ -567,6 +601,18 @@ class TpuHashAggregateExec(TpuExec):
                 # downstream merge sorts a few thousand rows — merging here
                 # would concat at FULL padded capacity and sort O(sum of
                 # input caps) rows inside the program (seconds at 16M).
+                if use_hash:
+                    outs, ncoll = [], jnp.asarray(0, jnp.int32)
+                    for b in batches:
+                        p, fl = self._aggregate_batch_hash(b)
+                        outs.append(p)
+                        ncoll = ncoll + fl.astype(jnp.int32)
+                    flag_col = DeviceColumn(T.INT,
+                                            jnp.zeros(16, jnp.int32),
+                                            jnp.ones(16, jnp.bool_))
+                    outs.append(ColumnBatch(_HASH_FLAGS_SCHEMA,
+                                            [flag_col], ncoll, 16))
+                    return outs
                 return [self._aggregate_batch(b) for b in batches]
             if not batches:
                 if self.key_exprs:
@@ -577,6 +623,20 @@ class TpuHashAggregateExec(TpuExec):
             return [self._aggregate_batch(merged)]
 
         return f
+
+    def postprocess_stage_outputs(self, ctx, outs, rerun):
+        """MXU-path stage epilogue: the trailing pseudo-batch's num_rows
+        counts flagged batches (key range over the slot table, NaN/Inf
+        float inputs).  Any flag discards the stage and re-runs the exact
+        sort variant — correctness never depends on data shape."""
+        if not outs or outs[-1].schema is not _HASH_FLAGS_SCHEMA:
+            return outs
+        flags = outs.pop()
+        if flags.host_num_rows():
+            self._hash_disabled = True
+            ctx.metric(self.op_id, "hashAggFallback").add(1)
+            return rerun()
+        return outs
 
     # -- core ---------------------------------------------------------------
 
@@ -640,6 +700,33 @@ class TpuHashAggregateExec(TpuExec):
             v = a.fn.finalize(bufs)
             cols.append(DeviceColumn(v.dtype, v.data, v.validity, v.offsets))
         return ColumnBatch(self.output_schema, cols, num_groups, cap)
+
+    def _aggregate_batch_hash(self, batch: ColumnBatch):
+        """(partial batch, fallback flag) via the MXU slot kernel — same
+        output layout as the sort-based update path.  flag=True means the
+        result is INVALID (key range exceeded the slot table, or a float
+        sum saw NaN/Inf) and the caller must re-run the sort path."""
+        from spark_rapids_tpu.kernels.hashagg import hash_group_aggregate
+        keyless = not self.key_exprs
+        key_vals = self._synth_key(batch) if keyless else \
+            self._eval_keys(batch)
+        key_schema = T.Schema([("__k", T.INT)]) if keyless else \
+            self.key_schema
+        ctx = TpuEvalCtx(batch)
+        agg_inputs = [a.fn.child.tpu_eval(ctx) for a in self.aggs]
+        group_keys, buffers, num_groups, collided = hash_group_aggregate(
+            batch, key_vals, agg_inputs, [a.fn for a in self.aggs],
+            key_schema, self.output_schema)
+        if keyless:
+            num_groups = jnp.asarray(1, jnp.int32)
+        cols = [] if keyless else list(group_keys.columns)
+        for bufs in buffers:
+            for b in bufs:
+                cols.append(DeviceColumn(b.dtype, b.data, b.validity,
+                                         b.offsets))
+        out = ColumnBatch(self.output_schema, cols, num_groups,
+                          group_keys.capacity)
+        return out, collided
 
     def partitions(self, ctx):
         child_schema = self.children[0].output_schema
@@ -705,7 +792,8 @@ class TpuHashAggregateExec(TpuExec):
             # buffers (no per-batch host sync); the downstream pipeline
             # break right-sizes them in one round trip.
             def gen(part):
-                partials = [self._run(db) for db in part]
+                batches = list(part)
+                partials = self._update_partials(ctx, batches)
                 if not partials:
                     return
                 if len(partials) == 1:
@@ -715,6 +803,21 @@ class TpuHashAggregateExec(TpuExec):
                 yield self._merge_run(merged)
 
         return [gen(p) for p in self.children[0].partitions(ctx)]
+
+    def _update_partials(self, ctx, batches):
+        """Per-batch partials, preferring the MXU slot path; any flagged
+        batch (key range over the slot table, or NaN/Inf float inputs —
+        device-verified) re-runs on the exact sort path, and the MXU path
+        turns off for this exec."""
+        if not self._hash_active(ctx):
+            return [self._run(db) for db in batches]
+        pairs = [self._run_hash(db) for db in batches]
+        flags = jax.device_get([f for _, f in pairs]) if pairs else []
+        if not any(bool(f) for f in flags):
+            return [p for p, _ in pairs]
+        self._hash_disabled = True
+        ctx.metric(self.op_id, "hashAggFallback").add(1)
+        return [self._run(db) for db in batches]
 
     def _merge_partials(self, merged: ColumnBatch) -> ColumnBatch:
         """Merge concatenated update-mode outputs back to one partial batch

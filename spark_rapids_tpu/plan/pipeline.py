@@ -137,21 +137,43 @@ def _materialize_source(src: PhysicalOp, ctx: ExecContext
     return batches
 
 
-def _run_stage(root: PhysicalOp, ctx: ExecContext) -> List[ColumnBatch]:
-    """Execute ``root``'s stage as one program; shrunk device outputs."""
-    cached = getattr(root, "_stage_cache", None)
-    if cached is None:
+def _stage_program(root: PhysicalOp, ctx: ExecContext, variant: str):
+    """(sources, jitted) for one variant of ``root``'s stage (ops like the
+    hash aggregate compile a fast path and an exact-fallback path)."""
+    cache = getattr(root, "_stage_cache", None)
+    if not isinstance(cache, dict):
+        cache = {}
+        root._stage_cache = cache
+    if variant not in cache:
         sources: List[PhysicalOp] = []
         fn = build_pipeline(root, ctx, sources, {}, root)
-        jitted = jax.jit(lambda args: tuple(fn(args)))
-        cached = (sources, jitted)
-        root._stage_cache = cached
-    sources, jitted = cached
+        cache[variant] = (sources, jax.jit(lambda args: tuple(fn(args))))
+    return cache[variant]
+
+
+def _run_stage(root: PhysicalOp, ctx: ExecContext) -> List[ColumnBatch]:
+    """Execute ``root``'s stage as one program; shrunk device outputs."""
+    variant_fn = getattr(root, "stage_variant", None)
+    variant = variant_fn(ctx) if variant_fn is not None else "default"
+    sources, jitted = _stage_program(root, ctx, variant)
     args = tuple(tuple(_materialize_source(s, ctx)) for s in sources)
     from spark_rapids_tpu.batch import colocate_batches
     args = tuple(tuple(bs) for bs in colocate_batches(args))
     ctx.metric("pipeline", "programs").add(1)
-    return _shrink_outputs(list(jitted(args)), ctx)
+    outs = _shrink_outputs(list(jitted(args)), ctx)
+    post = getattr(root, "postprocess_stage_outputs", None)
+    if post is not None:
+        def rerun():
+            # the op flipped its variant (e.g. hash -> exact sort);
+            # re-execute on the SAME materialized source batches
+            v2 = variant_fn(ctx) if variant_fn is not None else "default"
+            s2, j2 = _stage_program(root, ctx, v2)
+            assert len(s2) == len(sources), "stage variants disagree"
+            ctx.metric("pipeline", "programs").add(1)
+            return _shrink_outputs(list(j2(args)), ctx)
+
+        outs = post(ctx, outs, rerun)
+    return outs
 
 
 def pipeline_collect(root: PhysicalOp, ctx: ExecContext

@@ -93,3 +93,22 @@ def test_exec_parity(ref):
 @pytest.mark.parametrize("ref", sorted(EXPR_MODULE_PARITY.keys()))
 def test_expr_module_parity(ref):
     importlib.import_module(EXPR_MODULE_PARITY[ref])
+
+
+def test_configs_docs_cover_full_registry():
+    """docs/configs.md must include every registered conf — including ones
+    defined in lazily-imported modules (catalog, multihost, python worker);
+    a partial-registry regeneration silently drops rows."""
+    import os
+
+    import spark_rapids_tpu.config as C
+    import spark_rapids_tpu.mem.catalog  # noqa: F401
+    import spark_rapids_tpu.parallel.multihost  # noqa: F401
+    import spark_rapids_tpu.runtime.python_worker  # noqa: F401
+    import spark_rapids_tpu.session  # noqa: F401
+
+    doc = open(os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "configs.md")).read()
+    missing = [e.key for e in C.registry()
+               if not e.internal and e.key not in doc]
+    assert not missing, f"configs.md missing: {missing}"
