@@ -14,15 +14,18 @@ import jax
 
 def run_bench(session, name: str, query_fn: Callable[[], object],
               iterations: int = 3, warmups: int = 1,
-              report_path: Optional[str] = None) -> Dict:
-    """query_fn() -> DataFrame; collects it warmups+iterations times."""
+              report_path: Optional[str] = None,
+              keep_rows: bool = False) -> Dict:
+    """query_fn() -> DataFrame; collects it warmups+iterations times.
+    ``keep_rows`` includes the last iteration's collected rows in the
+    report (for callers that checksum results)."""
     times: List[float] = []
-    rows = 0
+    rows: List = []
     for _ in range(warmups):
-        rows = len(query_fn().collect())
+        rows = query_fn().collect()
     for _ in range(iterations):
         t0 = time.monotonic()
-        rows = len(query_fn().collect())
+        rows = query_fn().collect()
         times.append(time.monotonic() - t0)
     report = {
         "benchmark": name,
@@ -30,7 +33,7 @@ def run_bench(session, name: str, query_fn: Callable[[], object],
         "times_s": [round(t, 4) for t in times],
         "best_s": round(min(times), 4),
         "mean_s": round(sum(times) / len(times), 4),
-        "result_rows": rows,
+        "result_rows": len(rows),
         "env": {
             "platform": platform.platform(),
             "devices": [str(d) for d in jax.devices()],
@@ -41,4 +44,6 @@ def run_bench(session, name: str, query_fn: Callable[[], object],
     if report_path:
         with open(report_path, "w") as f:
             json.dump(report, f, indent=2)
+    if keep_rows:
+        report["rows"] = rows
     return report

@@ -1,0 +1,157 @@
+"""File-backed TPC-H SF1 run: generate parquet tables once (lineitem = 6M
+rows — true TPC-H SF1 row counts), run every TPC-H-like query on the TPU
+engine AND the CPU engine from the files, verify agreement, and emit a
+timing table (the BenchUtils.runBench role,
+integration_tests/.../common/BenchUtils.scala:109-240).
+
+    python -m spark_rapids_tpu.benchmarks.sf1_run [--sf 1.0] [--out BENCH_SF1.md]
+
+Correctness: row counts must match exactly; numeric columns are
+checksummed (sums rounded to 2dp) and compared within float-agg
+tolerance.  The parquet dataset is cached under /tmp keyed by scale.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+# TPC-H SF1 row counts; the synthetic generator's own `sf` knob is
+# rows = sf * 60_000 for lineitem, so generator_sf = 100 * true_sf
+_GEN_PER_TRUE_SF = 100
+
+
+def _dataset_dir(true_sf: float) -> str:
+    import tempfile
+    return os.path.join(tempfile.gettempdir(),
+                        f"rapids_tpu_tpch_sf{true_sf:g}")
+
+
+def generate_dataset(true_sf: float, num_partitions: int = 4) -> str:
+    """Write the TPC-H-like tables as parquet once; returns the dir."""
+    from spark_rapids_tpu.benchmarks import datagen
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.session import TpuSparkSession
+
+    root = _dataset_dir(true_sf)
+    marker = os.path.join(root, "_COMPLETE")
+    if os.path.exists(marker):
+        return root
+    gen_sf = true_sf * _GEN_PER_TRUE_SF
+    s = TpuSparkSession(RapidsConf({"spark.rapids.sql.enabled": False}))
+    for name, data in [
+        ("lineitem", datagen.gen_lineitem(gen_sf)),
+        ("orders", datagen.gen_orders(gen_sf)),
+        ("customer", datagen.gen_customer(gen_sf)),
+        ("supplier", datagen.gen_supplier(gen_sf)),
+        ("nation", datagen.gen_nation()),
+    ]:
+        df = s.create_dataframe(data, num_partitions=num_partitions)
+        df.write_parquet(os.path.join(root, name), mode="overwrite")
+        print(f"wrote {name}", flush=True)
+    open(marker, "w").write("ok")
+    return root
+
+
+def _session(tpu: bool, root: str):
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.session import TpuSparkSession
+    s = TpuSparkSession(RapidsConf({
+        "spark.rapids.sql.enabled": tpu,
+        "spark.sql.shuffle.partitions": 4,
+        "spark.rapids.sql.variableFloatAgg.enabled": True,
+    }))
+    for name in ("lineitem", "orders", "customer", "supplier", "nation"):
+        df = s.read.parquet(os.path.join(root, name))
+        if tpu:
+            df = df.cache()  # device-resident across queries, spillable
+        df.create_or_replace_temp_view(name)
+    return s
+
+
+def _checksum(rows):
+    """(row count, rounded numeric sums) — agreement proxy for large
+    results where a full row-by-row compare would dominate the run."""
+    if not rows:
+        return (0, ())
+    sums = []
+    for j in range(len(rows[0])):
+        v = [r[j] for r in rows if r[j] is not None]
+        if v and isinstance(v[0], (int, float)) and \
+                not isinstance(v[0], bool):
+            sums.append(round(float(sum(v)), 2))
+    return (len(rows), tuple(sums))
+
+
+def run(true_sf: float, out_path: str) -> dict:
+    from spark_rapids_tpu.benchmarks.bench_utils import run_bench
+    from spark_rapids_tpu.benchmarks.tpch_like import QUERIES
+
+    root = generate_dataset(true_sf)
+    results = {}
+    for label, tpu in (("tpu", True), ("cpu", False)):
+        s = _session(tpu, root)
+        for qname in sorted(QUERIES):
+            sql = QUERIES[qname]
+            rep = run_bench(s, qname, lambda: s.sql(sql),
+                            iterations=1, warmups=1, keep_rows=True)
+            r = results.setdefault(qname, {})
+            r[f"{label}_s"] = round(rep["best_s"], 3)
+            r[f"{label}_check"] = _checksum(rep["rows"])
+            print(f"{label} {qname}: {r[f'{label}_s']}s "
+                  f"rows={r[f'{label}_check'][0]}", flush=True)
+
+    lines = [
+        f"# TPC-H-like SF{true_sf:g} file-backed timings",
+        "",
+        "Parquet-backed run (lineitem = "
+        f"{int(true_sf * 6_000_000):,} rows); TPU inputs device-cached "
+        "after the first read (spillable).  Checksums = (row count, "
+        "rounded numeric column sums); both engines must agree.",
+        "",
+        "| query | tpu s | cpu s | speedup | rows | agree |",
+        "|---|---|---|---|---|---|",
+    ]
+    all_ok = True
+    for qname in sorted(results):
+        r = results[qname]
+        tc, cc = r["tpu_check"], r["cpu_check"]
+        ok = tc[0] == cc[0] and len(tc[1]) == len(cc[1]) and all(
+            abs(a - b) <= 1e-4 * max(1.0, abs(a), abs(b))
+            for a, b in zip(tc[1], cc[1]))
+        all_ok = all_ok and ok
+        sp = r["cpu_s"] / r["tpu_s"] if r["tpu_s"] else float("inf")
+        lines.append(f"| {qname} | {r['tpu_s']} | {r['cpu_s']} | "
+                     f"{sp:.2f}x | {tc[0]} | {'yes' if ok else 'NO'} |")
+        r["speedup"] = round(sp, 3)
+        r["agree"] = ok
+    tot_t = sum(r["tpu_s"] for r in results.values())
+    tot_c = sum(r["cpu_s"] for r in results.values())
+    ratio = f"{tot_c / tot_t:.2f}x" if tot_t > 0 else "n/a"
+    lines += ["",
+              f"Total steady-state: tpu {tot_t:.2f}s, cpu {tot_c:.2f}s "
+              f"({ratio})", ""]
+    with open(out_path, "w") as f:
+        f.write("\n".join(lines))
+    print(f"\nwrote {out_path}; all_agree={all_ok}", flush=True)
+    return {"all_agree": all_ok, "queries": results,
+            "total_tpu_s": round(tot_t, 3), "total_cpu_s": round(tot_c, 3)}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sf", type=float, default=1.0)
+    ap.add_argument("--out", default="BENCH_SF1.md")
+    args = ap.parse_args(argv)
+    rep = run(args.sf, args.out)
+    print(json.dumps({"sf": args.sf, "all_agree": rep["all_agree"],
+                      "total_tpu_s": rep["total_tpu_s"],
+                      "total_cpu_s": rep["total_cpu_s"]}))
+    return 0 if rep["all_agree"] else 1
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -73,6 +73,16 @@ def gen_store(seed: int = 23) -> Dict:
     }
 
 
+def gen_promotion(seed: int = 25) -> Dict:
+    n = 30
+    r = np.random.RandomState(seed)
+    return {
+        "p_promo_sk": (T.LONG, np.arange(1, n + 1)),
+        "p_channel_email": (T.STRING, r.choice(["Y", "N"], n)),
+        "p_channel_event": (T.STRING, r.choice(["Y", "N"], n)),
+    }
+
+
 def gen_store_sales(sf: float, seed: int = 24) -> Dict:
     n = max(100, int(sf * 100_000))
     r = np.random.RandomState(seed)
@@ -85,6 +95,8 @@ def gen_store_sales(sf: float, seed: int = 24) -> Dict:
         "ss_item_sk": (T.LONG, r.randint(1, n_item + 1, n)),
         "ss_customer_sk": (T.LONG, r.randint(1, n_cust + 1, n)),
         "ss_store_sk": (T.LONG, r.randint(1, 13, n)),
+        "ss_promo_sk": (T.LONG, r.randint(1, 31, n)),
+        "ss_ticket_number": (T.LONG, r.randint(1, n // 3 + 2, n)),
         "ss_quantity": (T.INT, qty.astype(np.int32)),
         "ss_sales_price": (T.DOUBLE, price),
         "ss_ext_sales_price": (T.DOUBLE, (price * qty).round(2)),
@@ -93,13 +105,29 @@ def gen_store_sales(sf: float, seed: int = 24) -> Dict:
     }
 
 
+def gen_store_returns(sf: float, seed: int = 26) -> Dict:
+    n = max(20, int(sf * 10_000))
+    r = np.random.RandomState(seed)
+    n_item = max(10, int(sf * 2_000))
+    n_cust = max(10, int(sf * 1_000))
+    return {
+        "sr_returned_date_sk": (T.LONG, r.randint(1, 731, n)),
+        "sr_item_sk": (T.LONG, r.randint(1, n_item + 1, n)),
+        "sr_customer_sk": (T.LONG, r.randint(1, n_cust + 1, n)),
+        "sr_return_quantity": (T.INT, r.randint(1, 30, n).astype(np.int32)),
+        "sr_return_amt": (T.DOUBLE, (r.rand(n) * 300).round(2)),
+    }
+
+
 def register_tpcds(session, sf: float = 0.1, num_partitions: int = 4):
     tables = {
         "store_sales": gen_store_sales(sf),
+        "store_returns": gen_store_returns(sf),
         "item": gen_item(sf),
         "customer": gen_customer(sf),
         "date_dim": gen_date_dim(),
         "store": gen_store(),
+        "promotion": gen_promotion(),
     }
     for name, data in tables.items():
         df = session.create_dataframe(data, num_partitions=num_partitions)
@@ -176,5 +204,242 @@ HAVING sum(ss_net_profit) > 0
 ORDER BY s_state, profit DESC
 """
 
-QUERIES = {"q3": Q3, "q7": Q7, "q42": Q42, "q52": Q52, "q55": Q55,
-           "q65": Q65}
+Q13 = """
+SELECT avg(ss_quantity) AS avg_qty,
+       avg(ss_ext_sales_price) AS avg_price,
+       sum(ss_ext_discount_amt) AS total_disc
+FROM store_sales
+JOIN store ON s_store_sk = ss_store_sk
+JOIN customer ON c_customer_sk = ss_customer_sk
+WHERE s_state IN ('CA', 'TX')
+  AND c_education IN ('College', '4 yr Degree')
+  AND ss_sales_price BETWEEN 50 AND 150
+"""
+
+Q19 = """
+SELECT i_brand, i_manufact_id, sum(ss_ext_sales_price) AS ext_price
+FROM store_sales
+JOIN date_dim ON d_date_sk = ss_sold_date_sk
+JOIN item ON i_item_sk = ss_item_sk
+JOIN customer ON c_customer_sk = ss_customer_sk
+JOIN store ON s_store_sk = ss_store_sk
+WHERE d_moy = 11 AND d_year = 1998 AND i_manufact_id < 40
+  AND c_state <> s_state
+GROUP BY i_brand, i_manufact_id
+ORDER BY ext_price DESC, i_brand, i_manufact_id
+LIMIT 100
+"""
+
+Q26 = """
+SELECT i_category,
+       avg(ss_quantity) AS agg1,
+       avg(ss_sales_price) AS agg2
+FROM store_sales
+JOIN promotion ON p_promo_sk = ss_promo_sk
+JOIN item ON i_item_sk = ss_item_sk
+WHERE p_channel_email = 'N' OR p_channel_event = 'N'
+GROUP BY i_category
+ORDER BY i_category
+"""
+
+Q29 = """
+SELECT i_category,
+       sum(ss_quantity) AS sold,
+       sum(sr_return_quantity) AS returned
+FROM store_sales
+JOIN store_returns ON sr_item_sk = ss_item_sk
+  AND sr_customer_sk = ss_customer_sk
+JOIN item ON i_item_sk = ss_item_sk
+GROUP BY i_category
+ORDER BY i_category
+"""
+
+Q36 = """
+SELECT i_category, profit,
+       rank() OVER (ORDER BY profit DESC) AS rk
+FROM (
+  SELECT i_category, sum(ss_net_profit) AS profit
+  FROM store_sales
+  JOIN item ON i_item_sk = ss_item_sk
+  GROUP BY i_category
+)
+ORDER BY rk, i_category
+"""
+
+Q43 = """
+SELECT s_state, d_moy, sum(ss_ext_sales_price) AS total
+FROM store_sales
+JOIN date_dim ON d_date_sk = ss_sold_date_sk
+JOIN store ON s_store_sk = ss_store_sk
+WHERE d_year = 1998
+GROUP BY s_state, d_moy
+ORDER BY s_state, d_moy
+"""
+
+Q48 = """
+SELECT sum(CASE WHEN ss_quantity BETWEEN 1 AND 20 THEN 1 ELSE 0 END)
+         AS bucket1,
+       sum(CASE WHEN ss_quantity BETWEEN 21 AND 40 THEN 1 ELSE 0 END)
+         AS bucket2,
+       sum(CASE WHEN ss_quantity BETWEEN 41 AND 100 THEN 1 ELSE 0 END)
+         AS bucket3
+FROM store_sales
+JOIN store ON s_store_sk = ss_store_sk
+WHERE s_state IN ('CA', 'NY', 'TX')
+"""
+
+Q53 = """
+SELECT i_manufact_id, d_moy, sum_sales,
+       avg(sum_sales) OVER (PARTITION BY i_manufact_id)
+         AS avg_manufact_sales
+FROM (
+  SELECT i_manufact_id, d_moy, sum(ss_sales_price) AS sum_sales
+  FROM store_sales
+  JOIN item ON i_item_sk = ss_item_sk
+  JOIN date_dim ON d_date_sk = ss_sold_date_sk
+  WHERE d_year = 1999 AND i_manufact_id < 20
+  GROUP BY i_manufact_id, d_moy
+)
+ORDER BY i_manufact_id, d_moy
+"""
+
+Q59 = """
+SELECT y1.s_state, y1.total AS sales_1998, y2.total AS sales_1999
+FROM (
+  SELECT s_state, sum(ss_ext_sales_price) AS total
+  FROM store_sales
+  JOIN date_dim ON d_date_sk = ss_sold_date_sk
+  JOIN store ON s_store_sk = ss_store_sk
+  WHERE d_year = 1998
+  GROUP BY s_state
+) y1
+JOIN (
+  SELECT s_state, sum(ss_ext_sales_price) AS total
+  FROM store_sales
+  JOIN date_dim ON d_date_sk = ss_sold_date_sk
+  JOIN store ON s_store_sk = ss_store_sk
+  WHERE d_year = 1999
+  GROUP BY s_state
+) y2 ON y1.s_state = y2.s_state
+ORDER BY y1.s_state
+"""
+
+Q61 = """
+SELECT p.s_state, p.promo_sales, t.total_sales
+FROM (
+  SELECT s_state, sum(ss_ext_sales_price) AS promo_sales
+  FROM store_sales
+  JOIN store ON s_store_sk = ss_store_sk
+  JOIN promotion ON p_promo_sk = ss_promo_sk
+  WHERE p_channel_email = 'Y' OR p_channel_event = 'Y'
+  GROUP BY s_state
+) p
+JOIN (
+  SELECT s_state, sum(ss_ext_sales_price) AS total_sales
+  FROM store_sales
+  JOIN store ON s_store_sk = ss_store_sk
+  GROUP BY s_state
+) t ON p.s_state = t.s_state
+ORDER BY p.s_state
+"""
+
+Q68 = """
+SELECT ss_ticket_number, ss_customer_sk,
+       sum(ss_ext_sales_price) AS amt,
+       sum(ss_net_profit) AS profit
+FROM store_sales
+JOIN store ON s_store_sk = ss_store_sk
+WHERE s_state = 'CA'
+GROUP BY ss_ticket_number, ss_customer_sk
+HAVING sum(ss_ext_sales_price) > 500
+ORDER BY ss_ticket_number, ss_customer_sk
+LIMIT 100
+"""
+
+Q73 = """
+SELECT c_state, count(DISTINCT ss_customer_sk) AS buyers,
+       count(*) AS line_items
+FROM store_sales
+JOIN customer ON c_customer_sk = ss_customer_sk
+GROUP BY c_state
+ORDER BY c_state
+"""
+
+Q79 = """
+SELECT s_state, ss_customer_sk, sum(ss_net_profit) AS profit
+FROM store_sales
+JOIN store ON s_store_sk = ss_store_sk
+JOIN date_dim ON d_date_sk = ss_sold_date_sk
+WHERE d_moy BETWEEN 1 AND 3
+GROUP BY s_state, ss_customer_sk
+HAVING sum(ss_net_profit) > 300
+ORDER BY s_state, profit DESC, ss_customer_sk
+LIMIT 100
+"""
+
+Q89 = """
+SELECT i_category, d_moy, sum_sales, avg_monthly_sales
+FROM (
+  SELECT i_category, d_moy, sum_sales,
+         avg(sum_sales) OVER (PARTITION BY i_category)
+           AS avg_monthly_sales
+  FROM (
+    SELECT i_category, d_moy, sum(ss_sales_price) AS sum_sales
+    FROM store_sales
+    JOIN item ON i_item_sk = ss_item_sk
+    JOIN date_dim ON d_date_sk = ss_sold_date_sk
+    WHERE d_year = 1998
+    GROUP BY i_category, d_moy
+  )
+)
+WHERE sum_sales > avg_monthly_sales
+ORDER BY i_category, d_moy
+"""
+
+Q98 = """
+SELECT i_category, i_brand, itemrevenue,
+       itemrevenue * 100.0 / cat_rev AS revenueratio
+FROM (
+  SELECT i_category, i_brand, itemrevenue,
+         sum(itemrevenue) OVER (PARTITION BY i_category) AS cat_rev
+  FROM (
+    SELECT i_category, i_brand, sum(ss_ext_sales_price) AS itemrevenue
+    FROM store_sales
+    JOIN item ON i_item_sk = ss_item_sk
+    JOIN date_dim ON d_date_sk = ss_sold_date_sk
+    WHERE d_year = 1999
+    GROUP BY i_category, i_brand
+  )
+)
+ORDER BY i_category, i_brand
+"""
+
+Q14 = """
+SELECT channel, i_category, sum(sales) AS total_sales,
+       count(*) AS groups_n
+FROM (
+  SELECT 'first_half' AS channel, i_category,
+         sum(ss_ext_sales_price) AS sales
+  FROM store_sales
+  JOIN item ON i_item_sk = ss_item_sk
+  JOIN date_dim ON d_date_sk = ss_sold_date_sk
+  WHERE d_moy BETWEEN 1 AND 6
+  GROUP BY i_category
+  UNION ALL
+  SELECT 'second_half' AS channel, i_category,
+         sum(ss_ext_sales_price) AS sales
+  FROM store_sales
+  JOIN item ON i_item_sk = ss_item_sk
+  JOIN date_dim ON d_date_sk = ss_sold_date_sk
+  WHERE d_moy BETWEEN 7 AND 12
+  GROUP BY i_category
+)
+GROUP BY channel, i_category
+ORDER BY channel, i_category
+"""
+
+QUERIES = {"q3": Q3, "q7": Q7, "q13": Q13, "q14": Q14, "q19": Q19,
+           "q26": Q26, "q29": Q29, "q36": Q36, "q42": Q42, "q43": Q43,
+           "q48": Q48, "q52": Q52, "q53": Q53, "q55": Q55, "q59": Q59,
+           "q61": Q61, "q65": Q65, "q68": Q68, "q73": Q73, "q79": Q79,
+           "q89": Q89, "q98": Q98}

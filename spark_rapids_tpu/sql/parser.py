@@ -18,6 +18,9 @@ from typing import List, Optional, Tuple
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.exprs import aggregates as A
+from spark_rapids_tpu.exprs.windows import (
+    WindowExpression as _WindowExpression,
+)
 from spark_rapids_tpu.exprs.base import (
     Alias, ColumnRef, Expression, Literal, SortOrder,
 )
@@ -623,6 +626,12 @@ def _build_function(name: str, args: List[Expression], star: bool,
 
 
 def _contains_agg(e: Expression) -> bool:
+    """True if ``e`` contains a GROUPING aggregate.  A window expression
+    is opaque here: avg(x) OVER (...) is a window computation over plain
+    rows (Spark classifies windowed aggregates as windows, not group
+    aggs), so it must not flip the select into aggregate mode."""
+    if isinstance(e, _WindowExpression):
+        return False
     if isinstance(e, A.AggregateFunction):
         return True
     return any(_contains_agg(c) for c in e.children)
