@@ -59,8 +59,17 @@ class Cast(UnaryExpression):
         elif src.is_fractional and dst.is_integral:
             lo, hi = _INT_BOUNDS[dst]
             x = jnp.nan_to_num(data, nan=0.0, posinf=float(hi), neginf=float(lo))
-            x = jnp.clip(x, float(lo), float(hi))
-            data = jnp.trunc(x).astype(dst.jnp_dtype)
+            # saturate the boundaries in INTEGER domain: the f64-emulated
+            # clip value (e.g. 2147483647.0 at ~48-bit mantissa) converts
+            # off-by-one on TPU
+            over = x >= float(hi)
+            under = x <= float(lo)
+            conv = jnp.trunc(jnp.clip(x, float(lo), float(hi))) \
+                .astype(dst.jnp_dtype)
+            data = jnp.where(over, jnp.asarray(hi, dst.jnp_dtype),
+                             jnp.where(under,
+                                       jnp.asarray(lo, dst.jnp_dtype),
+                                       conv))
         elif dst == T.BOOLEAN:
             data = data != 0
         elif src == T.DATE and dst == T.TIMESTAMP:

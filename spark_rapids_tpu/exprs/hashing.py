@@ -69,19 +69,38 @@ def _words_of(v: DevVal, xp):
         import jax
         return [jax.lax.bitcast_convert_type(bits, jnp.uint32)], 4
     if dt == T.DOUBLE:
+        # Two-float (hi, lo) encoding hashed as two f32 words: the TPU's
+        # f64 emulation stores doubles as f32 pairs and cannot bitcast raw
+        # IEEE-64 bits at all, so BOTH engines hash this encoding — ~48
+        # effective mantissa bits, matching the emulation's own precision.
+        # Diverges from Spark's raw-bit double hash (partition placement
+        # only; docs/compatibility.md).
         x = xp.where(data == 0, xp.zeros_like(data), data)
         if xp is np:
-            u = np.frombuffer(np.asarray(x, dtype=np.float64).tobytes(),
-                              dtype=np.uint32).copy()
-            lo, hi = u[0::2], u[1::2]  # little endian
+            x64 = np.asarray(x, dtype=np.float64)
+            hi32 = x64.astype(np.float32)
+            lo32 = (x64 - hi32.astype(np.float64)).astype(np.float32)
+
+            def norm_np(f):
+                f = np.where(np.isnan(f), np.float32(np.nan), f)
+                return np.where(f == 0, np.float32(0.0), f)
+
+            hi_b = np.frombuffer(norm_np(hi32).tobytes(),
+                                 np.uint32).copy()
+            lo_b = np.frombuffer(norm_np(lo32).tobytes(),
+                                 np.uint32).copy()
         else:
             import jax
-            # f64 -> u32[...,2]; avoids u64 bitcast which TPU's X64 rewriting
-            # does not support.
-            pair = jax.lax.bitcast_convert_type(x.astype(jnp.float64),
-                                                jnp.uint32)
-            lo, hi = pair[..., 0], pair[..., 1]
-        return [lo, hi], 8
+            hi32 = x.astype(jnp.float32)
+            lo32 = (x - hi32.astype(jnp.float64)).astype(jnp.float32)
+
+            def norm_j(f):
+                f = jnp.where(jnp.isnan(f), jnp.float32(jnp.nan), f)
+                return jnp.where(f == 0, jnp.float32(0.0), f)
+
+            hi_b = jax.lax.bitcast_convert_type(norm_j(hi32), jnp.uint32)
+            lo_b = jax.lax.bitcast_convert_type(norm_j(lo32), jnp.uint32)
+        return [lo_b, hi_b], 8
     raise TypeError(f"murmur3 on {dt}")
 
 

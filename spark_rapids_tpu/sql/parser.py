@@ -460,9 +460,8 @@ class Parser:
         return e
 
     def parse_over(self, fn: Expression) -> Expression:
-        from spark_rapids_tpu.exprs.windows import (
-            WindowExpression, WindowFrame,
-        )
+        from spark_rapids_tpu.exprs.windows import WindowFrame
+        WindowExpression = _WindowExpression
         self.expect("op", "(")
         part = []
         orders = []
