@@ -31,8 +31,10 @@ def _dataset_dir(true_sf: float) -> str:
 
 def generate_dataset(true_sf: float, num_partitions: int = 4) -> str:
     """Write the TPC-H-like tables as parquet once; returns the dir.
-    The completion marker records a schema fingerprint so a datagen
-    change can never silently reuse stale files."""
+    The completion marker records a schema fingerprint, so a schema or
+    scale change regenerates instead of reusing stale files (a pure
+    value-distribution change with the same columns still needs a manual
+    directory wipe)."""
     from spark_rapids_tpu.benchmarks import datagen
     from spark_rapids_tpu.config import RapidsConf
     from spark_rapids_tpu.session import TpuSparkSession
@@ -47,9 +49,10 @@ def generate_dataset(true_sf: float, num_partitions: int = 4) -> str:
         ("supplier", datagen.gen_supplier),
         ("nation", lambda _sf: datagen.gen_nation()),
     ]
-    # cheap fingerprint: every table's column names (from a tiny-scale
-    # probe of the same generators) + the scale
-    cols = {n: sorted(g(0.001).keys()) for n, g in tables}
+    # cheap fingerprint: every table's column names + dtypes (from a
+    # tiny-scale probe of the same generators) + the scale
+    cols = {n: sorted((k, str(dt)) for k, (dt, _) in g(0.001).items())
+            for n, g in tables}
     fingerprint = json.dumps({"cols": cols, "gen_sf": gen_sf},
                              sort_keys=True)
     if os.path.exists(marker) and open(marker).read() == fingerprint:

@@ -6,8 +6,12 @@ type/op envelope the same way TpchLike is).
 
 Query shapes covered: dimension-filtered fact scans with multi-way joins,
 group-by + order-by + limit reporting rollups (q3/q42/q52/q55 family),
-multi-aggregate demographic profiles (q7), and a two-level aggregation with
-a HAVING-style post-filter (q65 family).
+multi-aggregate demographic profiles (q7), two-level aggregation with a
+HAVING-style post-filter (q65 family), windowed category shares
+(q53/q89/q98), year-over-year self joins (q2/q59), rollup-via-union
+(q22), three-branch channel unions (q14/q33), running cumulative windows
+(q51), semi-join frequent-buyer selection (q34), premium-vs-average
+subquery joins (q92), and return-adjusted left joins (q93).
 """
 
 from __future__ import annotations
@@ -438,8 +442,143 @@ GROUP BY channel, i_category
 ORDER BY channel, i_category
 """
 
+Q2 = """
+SELECT m1.d_moy, m1.total AS total_1998, m2.total AS total_1999,
+       m2.total / m1.total AS growth
+FROM (
+  SELECT d_moy, sum(ss_ext_sales_price) AS total
+  FROM store_sales
+  JOIN date_dim ON d_date_sk = ss_sold_date_sk
+  WHERE d_year = 1998
+  GROUP BY d_moy
+) m1
+JOIN (
+  SELECT d_moy, sum(ss_ext_sales_price) AS total
+  FROM store_sales
+  JOIN date_dim ON d_date_sk = ss_sold_date_sk
+  WHERE d_year = 1999
+  GROUP BY d_moy
+) m2 ON m1.d_moy = m2.d_moy
+ORDER BY m1.d_moy
+"""
+
+Q22 = """
+SELECT i_category, i_brand, avg(ss_quantity) AS qoh
+FROM store_sales
+JOIN item ON i_item_sk = ss_item_sk
+GROUP BY i_category, i_brand
+UNION ALL
+SELECT i_category, 'ALL' AS i_brand, avg(ss_quantity) AS qoh
+FROM store_sales
+JOIN item ON i_item_sk = ss_item_sk
+GROUP BY i_category
+ORDER BY i_category, i_brand, qoh
+"""
+
+Q25 = """
+SELECT i_category, s_state,
+       sum(ss_net_profit) AS profit,
+       min(ss_net_profit) AS min_profit,
+       max(ss_net_profit) AS max_profit
+FROM store_sales
+JOIN item ON i_item_sk = ss_item_sk
+JOIN store ON s_store_sk = ss_store_sk
+WHERE ss_quantity > 10
+GROUP BY i_category, s_state
+ORDER BY i_category, s_state
+"""
+
+Q33 = """
+SELECT i_manufact_id, sum(total_sales) AS total_sales
+FROM (
+  SELECT i_manufact_id, sum(ss_ext_sales_price) AS total_sales
+  FROM store_sales
+  JOIN item ON i_item_sk = ss_item_sk
+  JOIN date_dim ON d_date_sk = ss_sold_date_sk
+  WHERE d_moy = 1
+  GROUP BY i_manufact_id
+  UNION ALL
+  SELECT i_manufact_id, sum(ss_ext_sales_price) AS total_sales
+  FROM store_sales
+  JOIN item ON i_item_sk = ss_item_sk
+  JOIN date_dim ON d_date_sk = ss_sold_date_sk
+  WHERE d_moy = 2
+  GROUP BY i_manufact_id
+  UNION ALL
+  SELECT i_manufact_id, sum(ss_ext_sales_price) AS total_sales
+  FROM store_sales
+  JOIN item ON i_item_sk = ss_item_sk
+  JOIN date_dim ON d_date_sk = ss_sold_date_sk
+  WHERE d_moy = 3
+  GROUP BY i_manufact_id
+)
+GROUP BY i_manufact_id
+ORDER BY total_sales DESC, i_manufact_id
+LIMIT 100
+"""
+
+Q34 = """
+SELECT c_state, count(*) AS frequent_buyers
+FROM customer
+LEFT SEMI JOIN (
+  SELECT ss_customer_sk
+  FROM store_sales
+  GROUP BY ss_customer_sk
+  HAVING count(*) > 15
+) f ON c_customer_sk = ss_customer_sk
+GROUP BY c_state
+ORDER BY c_state
+"""
+
+Q51 = """
+SELECT i_category, d_moy, sum_sales,
+       sum(sum_sales) OVER (PARTITION BY i_category ORDER BY d_moy
+                            ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW)
+         AS cume_sales
+FROM (
+  SELECT i_category, d_moy, sum(ss_sales_price) AS sum_sales
+  FROM store_sales
+  JOIN item ON i_item_sk = ss_item_sk
+  JOIN date_dim ON d_date_sk = ss_sold_date_sk
+  WHERE d_year = 1998
+  GROUP BY i_category, d_moy
+)
+ORDER BY i_category, d_moy
+"""
+
+Q92 = """
+SELECT i_category, count(*) AS premium_items
+FROM item
+JOIN (
+  SELECT i_category AS cat, avg(i_current_price) AS avg_price
+  FROM item
+  GROUP BY i_category
+) a ON i_category = cat
+WHERE i_current_price > avg_price * 1.2
+GROUP BY i_category
+ORDER BY i_category
+"""
+
+Q93 = """
+SELECT ss_customer_sk, sum(act_sales) AS sumsales
+FROM (
+  SELECT ss_customer_sk,
+         CASE WHEN sr_return_quantity IS NOT NULL
+              THEN (ss_quantity - sr_return_quantity) * ss_sales_price
+              ELSE ss_quantity * ss_sales_price END AS act_sales
+  FROM store_sales
+  LEFT JOIN store_returns ON sr_item_sk = ss_item_sk
+    AND sr_customer_sk = ss_customer_sk
+)
+GROUP BY ss_customer_sk
+ORDER BY sumsales DESC, ss_customer_sk
+LIMIT 100
+"""
+
 QUERIES = {"q3": Q3, "q7": Q7, "q13": Q13, "q14": Q14, "q19": Q19,
            "q26": Q26, "q29": Q29, "q36": Q36, "q42": Q42, "q43": Q43,
            "q48": Q48, "q52": Q52, "q53": Q53, "q55": Q55, "q59": Q59,
            "q61": Q61, "q65": Q65, "q68": Q68, "q73": Q73, "q79": Q79,
-           "q89": Q89, "q98": Q98}
+           "q89": Q89, "q98": Q98,
+           "q2": Q2, "q22": Q22, "q25": Q25, "q33": Q33,
+           "q34": Q34, "q51": Q51, "q92": Q92, "q93": Q93}

@@ -75,11 +75,17 @@ def _words_of(v: DevVal, xp):
         # effective mantissa bits, matching the emulation's own precision.
         # Diverges from Spark's raw-bit double hash (partition placement
         # only; docs/compatibility.md).
+        # Magnitudes past float32 range are ONE equality class per sign on
+        # the TPU engine (the f32-pair emulation saturates them at ingest),
+        # so both engines canonicalize lo to 0 when hi is non-finite —
+        # keys that compare equal on device must hash equal.
         x = xp.where(data == 0, xp.zeros_like(data), data)
         if xp is np:
             x64 = np.asarray(x, dtype=np.float64)
-            hi32 = x64.astype(np.float32)
-            lo32 = (x64 - hi32.astype(np.float64)).astype(np.float32)
+            with np.errstate(invalid="ignore", over="ignore"):
+                hi32 = x64.astype(np.float32)
+                lo32 = (x64 - hi32.astype(np.float64)).astype(np.float32)
+            lo32 = np.where(np.isfinite(hi32), lo32, np.float32(0.0))
 
             def norm_np(f):
                 f = np.where(np.isnan(f), np.float32(np.nan), f)
@@ -93,6 +99,7 @@ def _words_of(v: DevVal, xp):
             import jax
             hi32 = x.astype(jnp.float32)
             lo32 = (x - hi32.astype(jnp.float64)).astype(jnp.float32)
+            lo32 = jnp.where(jnp.isfinite(hi32), lo32, jnp.float32(0.0))
 
             def norm_j(f):
                 f = jnp.where(jnp.isnan(f), jnp.float32(jnp.nan), f)

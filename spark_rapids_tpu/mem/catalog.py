@@ -222,6 +222,49 @@ class BufferCatalog:
             victim._spill_to_disk(self._dir())
             self.metrics["spilled_to_disk"] += 1
 
+    def handle_alloc_failure(self, pinned=()) -> int:
+        """Spill ALL device-tier spillables; bytes freed.
+
+        The DeviceMemoryEventHandler role (DeviceMemoryEventHandler.scala:35):
+        RMM invokes the reference's handler from inside a failed cudaMalloc;
+        XLA exposes no such callback, so the engine instead catches the
+        RESOURCE_EXHAUSTED runtime error at dispatch boundaries
+        (:func:`run_with_oom_retry`) and calls this.  A real device OOM means
+        the soft budget under-counted (unregistered transients, fragmentation),
+        so everything spillable goes to host, not just down to the budget.
+
+        ``pinned`` holds batches the retrying computation still references
+        (its input args): spilling those would free nothing — the jax buffers
+        stay alive through the caller's reference — while marking the handle
+        host-tier, so a later ``get()`` would allocate a SECOND device copy.
+        They are skipped and excluded from the freed count.
+        """
+        # Pin by LEAF array identity, not batch-wrapper identity: colocation
+        # may rebuild wrappers around the same device arrays, and only a
+        # handle whose underlying buffers are aliased by the retrying args
+        # is futile to spill.
+        import jax
+        pinned_ids = {id(leaf) for b in pinned
+                      for leaf in jax.tree_util.tree_leaves(b)}
+        freed = 0
+        with self._lock:
+            victims = sorted(
+                (h for h in self._handles.values()
+                 if h.tier == SpillableBatch.TIER_DEVICE and not h.closed
+                 and not any(id(leaf) in pinned_ids for leaf in
+                             jax.tree_util.tree_leaves(h._device))),
+                key=lambda h: (h.priority, h.batch_id))
+            for victim in victims:
+                freed += victim.device_bytes
+                victim._spill_to_host()
+                self.metrics["spilled_to_host"] += 1
+            if victims:
+                self._enforce_host_budget()
+            if freed:
+                self.metrics["oom_spill_bytes"] = \
+                    self.metrics.get("oom_spill_bytes", 0) + freed
+        return freed
+
     def _pick_victim(self, tier: int, exclude: int
                      ) -> Optional[SpillableBatch]:
         best = None
@@ -233,3 +276,36 @@ class BufferCatalog:
                      h.batch_id < best.batch_id):
                 best = h
         return best
+
+
+def is_device_oom(err: BaseException) -> bool:
+    """True when ``err`` is an XLA out-of-device-memory failure.  JAX raises
+    ``XlaRuntimeError``/``JaxRuntimeError`` whose message carries the ABSL
+    status code name; allocation failures are RESOURCE_EXHAUSTED."""
+    return "RESOURCE_EXHAUSTED" in str(err) \
+        and type(err).__name__ in ("XlaRuntimeError", "JaxRuntimeError")
+
+
+def run_with_oom_retry(catalog: "BufferCatalog", thunk, retries: int = 2,
+                       pinned=(), on_retry=None):
+    """Run ``thunk`` and, on a device OOM, spill everything spillable and
+    re-run — the engine-side analogue of the reference's alloc-failure →
+    synchronous-spill → retry loop (DeviceMemoryEventHandler.scala:35,
+    RmmRapidsRetryIterator.scala's withRetry).  Gives up when a retry frees
+    nothing (spilling can no longer help) or ``retries`` is exhausted.
+    ``pinned``: batches the thunk re-reads on retry (see
+    :meth:`BufferCatalog.handle_alloc_failure`).
+    """
+    attempt = 0
+    while True:
+        try:
+            return thunk()
+        except Exception as e:  # noqa: BLE001 - filtered by is_device_oom
+            if not is_device_oom(e) or attempt >= retries:
+                raise
+            freed = catalog.handle_alloc_failure(pinned=pinned)
+            if freed == 0:
+                raise
+            if on_retry is not None:
+                on_retry(freed)
+            attempt += 1

@@ -139,11 +139,15 @@ class HostToDeviceExec(TpuExec):
         t_metric = ctx.metric(self.op_id, "stageTime")
 
         def gen(part):
+            from spark_rapids_tpu.mem.catalog import run_with_oom_retry
+            from spark_rapids_tpu.runtime.device import DeviceRuntime
+            catalog = DeviceRuntime.get(ctx.conf).catalog
             for hb in part:
                 t0 = time.monotonic()
                 if ctx.semaphore is not None:
                     ctx.semaphore.acquire()
-                yield host_to_device(hb, device=ctx.device)
+                yield run_with_oom_retry(
+                    catalog, lambda: host_to_device(hb, device=ctx.device))
                 t_metric.add(time.monotonic() - t0)
 
         return [gen(p) for p in child_parts]

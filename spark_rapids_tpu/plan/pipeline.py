@@ -151,6 +151,19 @@ def _stage_program(root: PhysicalOp, ctx: ExecContext, variant: str):
     return cache[variant]
 
 
+def _run_oom_guarded(ctx: ExecContext, thunk, args=()):
+    """Dispatch a stage program under the OOM→spill→retry guard
+    (DeviceMemoryEventHandler.scala:35 role; see mem.catalog).  ``args`` —
+    the stage's input batches, still referenced by the retry — are pinned
+    so the spill pass doesn't waste a pass "freeing" live buffers."""
+    from spark_rapids_tpu.mem.catalog import run_with_oom_retry
+    from spark_rapids_tpu.runtime.device import DeviceRuntime
+    pinned = [b for bs in args for b in bs]
+    return run_with_oom_retry(
+        DeviceRuntime.get(ctx.conf).catalog, thunk, pinned=pinned,
+        on_retry=lambda _freed: ctx.metric("pipeline", "oom_retries").add(1))
+
+
 def _run_stage(root: PhysicalOp, ctx: ExecContext) -> List[ColumnBatch]:
     """Execute ``root``'s stage as one program; shrunk device outputs."""
     variant_fn = getattr(root, "stage_variant", None)
@@ -160,7 +173,8 @@ def _run_stage(root: PhysicalOp, ctx: ExecContext) -> List[ColumnBatch]:
     from spark_rapids_tpu.batch import colocate_batches
     args = tuple(tuple(bs) for bs in colocate_batches(args))
     ctx.metric("pipeline", "programs").add(1)
-    outs = _shrink_outputs(list(jitted(args)), ctx)
+    outs = _run_oom_guarded(ctx, lambda: _shrink_outputs(list(jitted(args)),
+                                                         ctx), args)
     post = getattr(root, "postprocess_stage_outputs", None)
     if post is not None:
         def rerun():
@@ -170,7 +184,8 @@ def _run_stage(root: PhysicalOp, ctx: ExecContext) -> List[ColumnBatch]:
             s2, j2 = _stage_program(root, ctx, v2)
             assert len(s2) == len(sources), "stage variants disagree"
             ctx.metric("pipeline", "programs").add(1)
-            return _shrink_outputs(list(j2(args)), ctx)
+            return _run_oom_guarded(ctx, lambda: _shrink_outputs(
+                list(j2(args)), ctx), args)
 
         outs = post(ctx, outs, rerun)
     return outs

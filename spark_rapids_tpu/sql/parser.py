@@ -461,7 +461,6 @@ class Parser:
 
     def parse_over(self, fn: Expression) -> Expression:
         from spark_rapids_tpu.exprs.windows import WindowFrame
-        WindowExpression = _WindowExpression
         self.expect("op", "(")
         part = []
         orders = []
@@ -484,7 +483,7 @@ class Parser:
             hi = self._frame_bound()
             frame = WindowFrame(kind, lo, hi)
         self.expect("op", ")")
-        return WindowExpression(fn, part, orders, frame)
+        return _WindowExpression(fn, part, orders, frame)
 
     def _frame_bound(self):
         if self.accept("keyword", "unbounded"):

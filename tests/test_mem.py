@@ -177,3 +177,58 @@ def test_exchange_split_memoized_for_retry():
             not ctx._deferred_handles
     finally:
         DeviceRuntime.reset()
+
+
+class _FakeOom:
+    """Raises a RESOURCE_EXHAUSTED error shaped like jax's for the first
+    ``failures`` calls, then succeeds — a stand-in for XLA's allocator."""
+
+    def __init__(self, failures=1):
+        self.failures = failures
+        self.calls = 0
+
+    def __call__(self):
+        self.calls += 1
+        if self.failures > 0:
+            self.failures -= 1
+            err = type("XlaRuntimeError", (Exception,), {})
+            raise err("RESOURCE_EXHAUSTED: Out of memory allocating "
+                      "1073741824 bytes.")
+        return "ok"
+
+
+def test_oom_retry_spills_and_reruns():
+    from spark_rapids_tpu.mem.catalog import run_with_oom_retry
+    cat = make_catalog(1 << 30)
+    h = cat.register(batch())
+    assert h.tier == SpillableBatch.TIER_DEVICE
+    thunk = _FakeOom(failures=1)
+    assert run_with_oom_retry(cat, thunk) == "ok"
+    assert thunk.calls == 2
+    # the alloc-failure handler spilled the registered batch to host
+    assert h.tier == SpillableBatch.TIER_HOST
+    assert cat.metrics.get("oom_spill_bytes", 0) > 0
+    # and the handle still rehydrates correctly afterwards
+    got = device_to_host(h.get()).to_pydict()
+    assert_batches_equal(HostBatch.from_pydict(DATA).to_pydict(), got)
+
+
+def test_oom_retry_gives_up_when_nothing_spillable():
+    from spark_rapids_tpu.mem.catalog import run_with_oom_retry
+    cat = make_catalog(1 << 30)  # nothing registered
+    thunk = _FakeOom(failures=1)
+    with pytest.raises(Exception, match="RESOURCE_EXHAUSTED"):
+        run_with_oom_retry(cat, thunk)
+    assert thunk.calls == 1  # no pointless retry
+
+
+def test_oom_retry_passes_other_errors_through():
+    from spark_rapids_tpu.mem.catalog import run_with_oom_retry
+    cat = make_catalog(1 << 30)
+    cat.register(batch())
+
+    def boom():
+        raise ValueError("RESOURCE_EXHAUSTED mentioned but wrong type")
+
+    with pytest.raises(ValueError):
+        run_with_oom_retry(cat, boom)
