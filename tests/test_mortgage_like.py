@@ -1,0 +1,60 @@
+"""Mortgage-like ETL benchmark correctness (MortgageSparkSuite pattern):
+each pipeline runs on the TPU engine and the CPU engine and must agree."""
+
+import pytest
+
+from spark_rapids_tpu.benchmarks.mortgage_like import (
+    aggregates_with_join, register_mortgage, run_mortgage,
+    simple_aggregates,
+)
+
+from compare import assert_tpu_cpu_equal
+
+SF = 0.05
+
+
+def _build(pipeline):
+    def build(s):
+        register_mortgage(s, sf=SF, num_partitions=3)
+        return pipeline(s)
+    return build
+
+
+def test_mortgage_etl():
+    assert_tpu_cpu_equal(
+        _build(run_mortgage),
+        approx=True, ignore_order=False)
+
+
+def test_mortgage_simple_aggregates():
+    assert_tpu_cpu_equal(_build(simple_aggregates), approx=True,
+                         ignore_order=False)
+
+
+def test_mortgage_aggregates_with_join():
+    assert_tpu_cpu_equal(_build(aggregates_with_join), approx=True,
+                         ignore_order=False)
+
+
+def test_mortgage_csv_roundtrip(tmp_path):
+    """The reference's Run.csv entry: the ETL driven from CSV files on
+    disk rather than registered in-memory views."""
+    from compare import tpu_session
+    from spark_rapids_tpu.benchmarks.mortgage_like import (
+        gen_acquisition, gen_performance,
+    )
+
+    s = tpu_session()
+    perf_dir = str(tmp_path / "perf")
+    acq_dir = str(tmp_path / "acq")
+    s.create_dataframe(gen_performance(SF), num_partitions=2) \
+        .write_csv(perf_dir, mode="overwrite")
+    s.create_dataframe(gen_acquisition(SF), num_partitions=2) \
+        .write_csv(acq_dir, mode="overwrite")
+
+    def build(sess):
+        sess.register_view("perf_raw", sess.read.csv(perf_dir))
+        sess.register_view("acq_raw", sess.read.csv(acq_dir))
+        return run_mortgage(sess)
+
+    assert_tpu_cpu_equal(build, approx=True, ignore_order=False)

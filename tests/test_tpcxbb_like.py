@@ -1,0 +1,20 @@
+"""TPCxBB-like query correctness (tpcxbb_test.py pattern): every query in
+the supported set runs on the TPU engine and the CPU engine and must
+agree."""
+
+import pytest
+
+from spark_rapids_tpu.benchmarks.tpcxbb_like import QUERIES, register_tpcxbb
+
+from compare import assert_tpu_cpu_equal
+
+SF = 0.05
+
+
+@pytest.mark.parametrize("qname", sorted(QUERIES.keys()))
+def test_tpcxbb_like_query(qname):
+    def build(s):
+        register_tpcxbb(s, sf=SF, num_partitions=3)
+        return s.sql(QUERIES[qname])
+
+    assert_tpu_cpu_equal(build, approx=True, ignore_order=False)
