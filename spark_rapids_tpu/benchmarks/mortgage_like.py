@@ -261,3 +261,27 @@ def aggregates_with_join(session):
     return (out.with_column("max_dti",
                             F.coalesce(out["max_dti"], F.lit(0.0)))
             .order_by("loan_id_hash"))
+
+
+def aggregates_with_percentiles(session):
+    """AggregatesWithPercentiles (MortgageSpark.scala:368-390): per
+    anonymized loan, min/max/avg plus the 50/75/90/99th exact
+    percentiles of the monthly interest rate.  The reference wraps each
+    output in round(x, 4); the "like" adaptation compares raw doubles
+    (fixed-decimal rounding sits one emulation ULP from a tie)."""
+    from spark_rapids_tpu import functions as F
+    perf = session.table("perf_raw")
+    anon = perf.with_column("loan_id_hash", F.hash(perf["loan_id"]))
+    return (anon.group_by("loan_id_hash")
+            .agg(F.min("interest_rate").alias("interest_rate_min"),
+                 F.max("interest_rate").alias("interest_rate_max"),
+                 F.avg("interest_rate").alias("interest_rate_avg"),
+                 F.percentile("interest_rate", 0.5)
+                 .alias("interest_rate_50p"),
+                 F.percentile("interest_rate", 0.75)
+                 .alias("interest_rate_75p"),
+                 F.percentile("interest_rate", 0.9)
+                 .alias("interest_rate_90p"),
+                 F.percentile("interest_rate", 0.99)
+                 .alias("interest_rate_99p"))
+            .order_by("loan_id_hash"))

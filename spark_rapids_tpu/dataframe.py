@@ -686,10 +686,85 @@ class GroupedData:
             else:
                 raise TypeError(f"not an aggregate: {a!r}")
         from spark_rapids_tpu.exprs import aggregates as A
+        if any(isinstance(a.fn, A.Percentile) for a in out):
+            return self._agg_with_percentile(out)
         if any(isinstance(a.fn, A.CountDistinct) for a in out):
             return self._agg_with_distinct(out)
         node = L.Aggregate(self.keys, self.names, out, self.df.plan)
         return DataFrame(node, self.df.session)
+
+    def _agg_with_percentile(self, out: List[AggregateExpression]
+                             ) -> DataFrame:
+        """Exact-percentile rewrite: no fixed-size aggregation buffer can
+        hold a percentile's state, so each percentile becomes a
+        rank-and-interpolate pipeline (the positional equivalent of
+        Spark's sort-based Percentile ImperativeAggregate):
+
+            rn  = row_number() OVER (keys ORDER BY x nulls-last) - 1
+            n   = count(x) OVER (keys)            -- non-null count
+            pos = p * (n - 1); lo = floor(pos); frac = pos - lo
+            w   = (rn == lo) * (1 - frac) + (rn == lo + 1) * frac
+            percentile = SUM(x * w) GROUP BY keys
+
+        Rows with NULL x sort after every valid row (rank >= n) and carry
+        a NULL weight, so they vanish in the SUM; an all-NULL group sums
+        to NULL, matching Spark.  Regular aggregates in the same list ride
+        the final aggregation unchanged."""
+        from spark_rapids_tpu import functions as F
+        from spark_rapids_tpu.exprs import aggregates as A
+        from spark_rapids_tpu.exprs.nullexprs import IsNull
+
+        if any(isinstance(a.fn, A.CountDistinct) for a in out):
+            raise NotImplementedError(
+                "percentile and count_distinct in one aggregation; "
+                "split into separate aggregations")
+        df = self.df
+        key_cols = [Column(k) for k in self.keys]
+        contrib_names: dict = {}
+        rank_cols: dict = {}  # repr(child) -> (rn, n): percentiles of the
+        #                       same child share one sorted window pass
+        for i, a in enumerate(out):
+            if not isinstance(a.fn, A.Percentile):
+                continue
+            x = Column(a.fn.child)
+            p = a.fn.percentage
+            ckey = repr(a.fn.child)
+            wp = F.Window.partition_by(*key_cols)
+            if ckey not in rank_cols:
+                nf, rn, n = (f"__pct_nf{i}", f"__pct_rn{i}", f"__pct_n{i}")
+                df = (df.with_column(nf, Column(IsNull(a.fn.child)))
+                      .with_column(rn, F.row_number().over(
+                          wp.order_by(F.col(nf), x)))
+                      .with_column(n, F.count(x).over(wp)))
+                rank_cols[ckey] = (rn, n)
+            rn, n = rank_cols[ckey]
+            cb = f"__pct_c{i}"
+            pos = (df[n] - 1).cast(T.DOUBLE) * F.lit(p)
+            lo = F.floor(pos)
+            frac = pos - lo
+            rn0 = (df[rn] - 1).cast(T.DOUBLE)
+            # Gate the VALUE, not a product: rows off the interpolation
+            # ranks contribute NULL (which SUM skips), so an inf/NaN
+            # elsewhere in the group cannot poison the sum via 0 * inf.
+            # The lo+1 branch only exists when frac > 0 — Spark's exact
+            # percentile never reads past rank lo on integer positions.
+            df = df.with_column(
+                cb,
+                F.when(rn0 == lo, x.cast(T.DOUBLE) * (F.lit(1.0) - frac))
+                .when((rn0 == lo + F.lit(1.0)) & (frac > F.lit(0.0)),
+                      x.cast(T.DOUBLE) * frac)
+                .otherwise(None))
+            contrib_names[i] = cb
+        gd = GroupedData(df, self.keys, self.names)
+        final: List[AggregateExpression] = []
+        for i, a in enumerate(out):
+            if i in contrib_names:
+                final.append(AggregateExpression(
+                    _resolve_agg(A.Sum(ColumnRef(contrib_names[i])),
+                                 df.schema), a.output_name))
+            else:
+                final.append(a)
+        return gd.agg(*final)
 
     def _agg_with_distinct(self, out: List[AggregateExpression]
                            ) -> DataFrame:

@@ -352,6 +352,43 @@ class Last(_FirstLast):
     _is_first = False
 
 
+class Percentile(AggregateFunction):
+    """percentile(x, p): Spark's exact percentile with linear
+    interpolation between closest ranks (used by the reference's mortgage
+    AggregatesWithPercentiles benchmark, MortgageSpark.scala:368-390).
+
+    Never executed directly: the dataframe layer rewrites it into a
+    rank-and-interpolate pipeline over existing machinery — row_number +
+    count windows produce each row's interpolation weight, a plain SUM
+    collapses them (see GroupedData._agg_with_percentile).  A buffered
+    two-phase implementation would need unbounded per-group state, which
+    the fixed-slot aggregate model deliberately excludes."""
+
+    def __init__(self, child: Expression, percentage: float):
+        if not (0.0 <= float(percentage) <= 1.0):
+            raise ValueError(
+                f"percentile percentage must be in [0, 1]: {percentage}")
+        self.percentage = float(percentage)
+        super().__init__(child)
+
+    def with_children(self, children):
+        return Percentile(children[0], self.percentage)
+
+    def _resolve_type(self):
+        dt = self.child.dtype
+        if dt is not T.NULL and not dt.is_numeric:  # NULL = unresolved yet
+            raise TypeError(f"percentile needs a numeric input, got {dt}")
+        self.dtype = T.DOUBLE
+        self.nullable = True
+
+    def tpu_supported(self, conf):
+        return None
+
+    def buffers(self):
+        raise AssertionError(
+            "Percentile must be rewritten before execution")
+
+
 class CountDistinct(AggregateFunction):
     """count(DISTINCT x).
 

@@ -64,6 +64,14 @@ def last(c, ignore_nulls: bool = False) -> Column:
     return Column(A.Last(e, ignore_nulls))
 
 
+def percentile(c, percentage: float) -> Column:
+    """Exact percentile with linear interpolation (Spark `percentile`);
+    rewritten to a rank-and-interpolate pipeline at aggregation time."""
+    from spark_rapids_tpu.exprs.aggregates import Percentile
+    c = col(c) if isinstance(c, str) else c
+    return Column(Percentile(_to_expr(c), percentage))
+
+
 def count_distinct(c) -> Column:
     """count(DISTINCT c) — rewritten by the dataframe layer into the
     two-level distinct-aggregate plan (GroupedData._agg_with_distinct)."""

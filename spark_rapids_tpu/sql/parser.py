@@ -541,6 +541,14 @@ def _build_function(name: str, args: List[Expression], star: bool,
     if name == "abs":
         from spark_rapids_tpu.exprs.arithmetic import Abs
         return Abs(args[0])
+    if name == "percentile":
+        from spark_rapids_tpu.exprs.base import Literal
+        if len(args) != 2 or not isinstance(args[1], Literal) \
+                or isinstance(args[1].value, bool) \
+                or not isinstance(args[1].value, (int, float)):
+            raise SyntaxError(
+                "percentile(expr, p) needs a numeric literal percentage")
+        return A.Percentile(args[0], float(args[1].value))
     if name in simple and simple[name] is not None:
         return simple[name](*args)
     if name == "coalesce":

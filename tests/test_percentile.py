@@ -1,0 +1,118 @@
+"""Exact-percentile aggregate: numpy ground truth + TPU/CPU engine
+agreement (the reference exposes percentile through Spark SQL; mortgage
+AggregatesWithPercentiles is its benchmark user)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu import types as T
+
+from compare import assert_tpu_cpu_equal, tpu_session
+
+DATA = {
+    "g": (T.STRING, ["a", "a", "a", "b", "b", "c", "c", "c", "c", "d"]),
+    "x": (T.DOUBLE, [5.0, 1.0, 3.0, 10.0, 20.0, 2.0, None, 8.0, 4.0,
+                     None]),
+    "y": (T.INT, [7, 1, 5, 2, 4, 9, 3, 6, 8, 0]),
+}
+
+
+def _expected(p):
+    """numpy linear interpolation == Spark exact percentile."""
+    groups = {"a": [5.0, 1.0, 3.0], "b": [10.0, 20.0],
+              "c": [2.0, 8.0, 4.0], "d": []}
+    out = {}
+    for g, vals in groups.items():
+        out[g] = None if not vals else float(np.percentile(vals, p * 100))
+    return out
+
+
+@pytest.mark.parametrize("p", [0.0, 0.25, 0.5, 0.9, 1.0])
+def test_percentile_ground_truth(p):
+    s = tpu_session()
+    df = s.create_dataframe(DATA, num_partitions=2)
+    rows = (df.group_by("g")
+            .agg(F.percentile("x", p).alias("pct"))
+            .order_by("g").collect())
+    exp = _expected(p)
+    assert len(rows) == 4
+    for g, v in rows:
+        if exp[g] is None:
+            assert v is None, f"group {g} at p={p}: {v}"
+        else:
+            assert v == pytest.approx(exp[g], rel=1e-6), f"group {g} p={p}"
+
+
+def test_percentile_with_regular_aggs():
+    def build(s):
+        df = s.create_dataframe(DATA, num_partitions=3)
+        return (df.group_by("g")
+                .agg(F.percentile("x", 0.5).alias("med"),
+                     F.sum("y").alias("sy"),
+                     F.count("x").alias("cx"),
+                     F.percentile("y", 0.75).alias("y75"))
+                .order_by("g"))
+
+    assert_tpu_cpu_equal(build, approx=True, ignore_order=False)
+
+
+def test_percentile_sql_grouped():
+    def build(s):
+        df = s.create_dataframe(DATA, num_partitions=2)
+        s.register_view("t", df)
+        return s.sql(
+            "SELECT g, percentile(x, 0.5) AS med FROM t "
+            "GROUP BY g ORDER BY g")
+
+    assert_tpu_cpu_equal(build, approx=True, ignore_order=False)
+
+
+def test_percentile_sql_global_ungrouped():
+    def build(s):
+        df = s.create_dataframe(DATA, num_partitions=2)
+        s.register_view("t", df)
+        return s.sql("SELECT percentile(y, 0.25) AS q1 FROM t")
+
+    assert_tpu_cpu_equal(build, approx=True, ignore_order=False)
+
+
+def test_percentile_ignores_inf_outside_interpolation_ranks():
+    """An inf in the group must not poison the sum (0 * inf = NaN): only
+    the two interpolation ranks may contribute."""
+    s = tpu_session()
+    df = s.create_dataframe({
+        "g": (T.STRING, ["a", "a", "a", "b", "b"]),
+        "x": (T.DOUBLE, [1.0, 2.0, float("inf"), float("-inf"), 5.0]),
+    }, num_partitions=2)
+    rows = dict(df.group_by("g")
+                .agg(F.percentile("x", 0.5).alias("med"))
+                .order_by("g").collect())
+    assert rows["a"] == pytest.approx(2.0)   # inf sorts last, untouched
+    # b interpolates between -inf and 5.0 -> -inf (a rank the
+    # interpolation genuinely touches may still produce an infinity)
+    assert rows["b"] == float("-inf")
+
+
+def test_percentile_sql_rejects_non_numeric_percentage():
+    s = tpu_session()
+    s.register_view("t", s.create_dataframe(DATA, num_partitions=1))
+    with pytest.raises(SyntaxError):
+        s.sql("SELECT percentile(x, 'abc') FROM t")
+
+
+def test_percentile_rejects_bad_percentage():
+    with pytest.raises(ValueError):
+        F.percentile("x", 1.5)
+
+
+def test_mortgage_percentiles_variant():
+    from spark_rapids_tpu.benchmarks.mortgage_like import (
+        aggregates_with_percentiles, register_mortgage,
+    )
+
+    def build(s):
+        register_mortgage(s, sf=0.03, num_partitions=3)
+        return aggregates_with_percentiles(s)
+
+    assert_tpu_cpu_equal(build, approx=True, ignore_order=False)
