@@ -17,6 +17,21 @@ STATUSES = ["F", "O", "P"]
 MODES = ["AIR", "FOB", "MAIL", "RAIL", "REG AIR", "SHIP", "TRUCK"]
 NATIONS = ["ALGERIA", "BRAZIL", "CANADA", "EGYPT", "FRANCE", "GERMANY",
            "INDIA", "JAPAN", "KENYA", "PERU", "CHINA", "ROMANIA"]
+REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+BRANDS = [f"Brand#{i}{j}" for i in range(1, 6) for j in range(1, 6)]
+TYPES = [f"{a} {b} {c}"
+         for a in ("STANDARD", "SMALL", "MEDIUM", "LARGE", "ECONOMY",
+                   "PROMO")
+         for b in ("ANODIZED", "BURNISHED", "PLATED", "POLISHED",
+                   "BRUSHED")
+         for c in ("TIN", "NICKEL", "BRASS", "STEEL", "COPPER")]
+CONTAINERS = [f"{a} {b}"
+              for a in ("SM", "MED", "LG", "JUMBO", "WRAP")
+              for b in ("CASE", "BOX", "BAG", "JAR", "PKG", "PACK",
+                        "CAN", "DRUM")]
+PART_NOUNS = ["forest", "green", "lemon", "navy", "slate", "rose",
+              "royal", "steel", "midnight", "linen"]
+PHONE_CODES = ["13", "17", "18", "23", "29", "30", "31", "32", "33"]
 
 _EPOCH_1992 = 8035   # days 1970->1992-01-01
 _EPOCH_1999 = 10592  # days 1970->1998-12-31
@@ -73,7 +88,16 @@ def gen_customer(sf: float, seed: int = 13) -> Dict:
         "c_nationkey": (T.INT, r.randint(0, len(NATIONS), n)),
         "c_mktsegment": (T.STRING, r.choice(SEGMENTS, n)),
         "c_acctbal": (T.DOUBLE, (r.rand(n) * 10000 - 1000).round(2)),
+        "c_phone": (T.STRING, _gen_phones(r, n)),
     }
+
+
+def _gen_phones(r, n):
+    code = r.randint(0, len(PHONE_CODES), n)
+    a, b, c = (r.randint(100, 999, n), r.randint(100, 999, n),
+               r.randint(1000, 9999, n))
+    return [f"{PHONE_CODES[code[i]]}-{a[i]}-{b[i]}-{c[i]}"
+            for i in range(n)]
 
 
 def gen_supplier(sf: float, seed: int = 14) -> Dict:
@@ -83,6 +107,52 @@ def gen_supplier(sf: float, seed: int = 14) -> Dict:
         "s_suppkey": (T.LONG, np.arange(1, n + 1)),
         "s_name": (T.STRING, [f"Supplier#{i:09d}" for i in range(1, n + 1)]),
         "s_nationkey": (T.INT, r.randint(0, len(NATIONS), n)),
+        "s_acctbal": (T.DOUBLE, (r.rand(n) * 11000 - 1000).round(2)),
+    }
+
+
+def gen_part(sf: float, seed: int = 15) -> Dict:
+    n = max(1, int(sf * 2_000))
+    r = np.random.RandomState(seed)
+    idx = r.randint(0, len(PART_NOUNS), (n, 3))
+    names = [f"{PART_NOUNS[i]} {PART_NOUNS[j]} {PART_NOUNS[k]}"
+             for i, j, k in idx]
+    return {
+        "p_partkey": (T.LONG, np.arange(1, n + 1)),
+        "p_name": (T.STRING, names),
+        "p_mfgr": (T.STRING,
+                   [f"Manufacturer#{i % 5 + 1}" for i in range(n)]),
+        "p_brand": (T.STRING, r.choice(BRANDS, n)),
+        "p_type": (T.STRING, r.choice(TYPES, n)),
+        "p_size": (T.INT, r.randint(1, 51, n).astype(np.int32)),
+        "p_container": (T.STRING, r.choice(CONTAINERS, n)),
+        "p_retailprice": (T.DOUBLE, (r.rand(n) * 2000 + 900).round(2)),
+    }
+
+
+def gen_partsupp(sf: float, seed: int = 16) -> Dict:
+    n_part = max(1, int(sf * 2_000))
+    n_supp = max(1, int(sf * 100))
+    # 4 DISTINCT suppliers per part, the TPC-H shape ((partkey, suppkey)
+    # is the table's primary key)
+    pk = np.repeat(np.arange(1, n_part + 1), 4)
+    offset = np.tile(np.arange(4), n_part)
+    sk = (pk * 7 + offset * max(1, n_supp // 4)) % n_supp + 1
+    r = np.random.RandomState(seed)
+    n = len(pk)
+    return {
+        "ps_partkey": (T.LONG, pk),
+        "ps_suppkey": (T.LONG, sk),
+        "ps_availqty": (T.INT, r.randint(1, 10_000, n).astype(np.int32)),
+        "ps_supplycost": (T.DOUBLE, (r.rand(n) * 1000 + 1).round(2)),
+    }
+
+
+def gen_region() -> Dict:
+    n = len(REGIONS)
+    return {
+        "r_regionkey": (T.INT, np.arange(n, dtype=np.int32)),
+        "r_name": (T.STRING, list(REGIONS)),
     }
 
 
@@ -103,6 +173,9 @@ def register_tpch(session, sf: float = 0.01, num_partitions: int = 4):
         ("customer", gen_customer(sf)),
         ("supplier", gen_supplier(sf)),
         ("nation", gen_nation()),
+        ("part", gen_part(sf)),
+        ("partsupp", gen_partsupp(sf)),
+        ("region", gen_region()),
     ]:
         df = session.create_dataframe(data, num_partitions=num_partitions)
         df.create_or_replace_temp_view(name)
