@@ -107,6 +107,8 @@ class HostColumn:
 def _pyval(dtype: T.DataType, v):
     if v is None:
         return None  # element-level NULL (host representation only)
+    if dtype.is_string:
+        return str(v)
     if dtype == T.BOOLEAN:
         return bool(v)
     if dtype.is_fractional:

@@ -451,6 +451,21 @@ class DataFrame:
 
     unionAll = union
 
+    def dropna(self, how: str = "any", thresh: Optional[int] = None,
+               subset: Optional[List[str]] = None) -> "DataFrame":
+        """Drop rows with null/NaN values (pyspark DataFrame.na.drop;
+        Spark plans it as a Filter over AtLeastNNonNulls)."""
+        from spark_rapids_tpu.exprs.nullexprs import AtLeastNNonNulls
+        cols = subset or [f.name for f in self.schema.fields]
+        if thresh is None:
+            if how not in ("any", "all"):
+                raise ValueError(
+                    f"how ({how!r}) should be 'any' or 'all'")
+            thresh = len(cols) if how == "any" else 1
+        e = AtLeastNNonNulls(thresh, *[
+            resolve(ColumnRef(c), self.schema) for c in cols])
+        return self.filter(Column(e))
+
     def distinct(self) -> "DataFrame":
         return DataFrame(L.Distinct(self.plan), self.session)
 

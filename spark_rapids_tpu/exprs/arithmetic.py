@@ -180,3 +180,14 @@ class Abs(UnaryExpression):
     def cpu_eval(self, ctx) -> CpuVal:
         v = self.child.cpu_eval(ctx)
         return CpuVal(v.dtype, np.abs(v.values), v.validity)
+
+
+class UnaryPositive(UnaryExpression):
+    """+x: identity on the value, kept as a node for plan parity
+    (Spark UnaryPositive)."""
+
+    def tpu_eval(self, ctx) -> DevVal:
+        return self.child.tpu_eval(ctx)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        return self.child.cpu_eval(ctx)

@@ -349,3 +349,47 @@ class FromUnixTime(UnaryExpression):
             t = _dt.datetime.fromtimestamp(int(s), tz=_dt.timezone.utc)
             out[i] = t.strftime(fmt)
         return CpuVal(T.STRING, out, v.validity)
+
+
+class WeekDay(_DatePart):
+    """weekday(date): 0 = Monday .. 6 = Sunday (Spark WeekDay;
+    DayOfWeek is the 1=Sunday variant)."""
+
+    def _part(self, days, xp):
+        return xp.mod(days + 3, 7)
+
+
+class ToUnixTimestamp(UnixTimestamp):
+    """to_unix_timestamp: same semantics as unix_timestamp
+    (datetimeExpressions' ToUnixTimestamp vs UnixTimestamp)."""
+
+
+class TimeAdd(UnaryExpression):
+    """timestamp + a literal interval (Spark TimeAdd with a
+    CalendarInterval of micros; month intervals are not representable as
+    a fixed duration and stay unsupported, as in the reference's
+    GpuTimeAdd which rejects months)."""
+
+    def __init__(self, child: Expression, interval_micros: int):
+        self.interval_micros = int(interval_micros)
+        super().__init__(child)
+
+    def with_children(self, children):
+        return TimeAdd(children[0], self.interval_micros)
+
+    def _resolve_type(self):
+        if self.child.dtype not in (T.TIMESTAMP, T.NULL):
+            raise TypeError(f"TimeAdd needs a timestamp, "
+                            f"got {self.child.dtype}")
+        self.dtype = T.TIMESTAMP
+        self.nullable = self.child.nullable
+
+    def tpu_eval(self, ctx) -> DevVal:
+        v = self.child.tpu_eval(ctx)
+        return DevVal(T.TIMESTAMP, v.data + self.interval_micros,
+                      v.validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        v = self.child.cpu_eval(ctx)
+        return CpuVal(T.TIMESTAMP, v.values + self.interval_micros,
+                      v.validity)

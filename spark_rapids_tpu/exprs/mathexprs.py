@@ -61,6 +61,14 @@ Signum = _make_unary("Signum", jnp.sign, np.sign)
 Rint = _make_unary("Rint", jnp.rint, np.rint)
 ToDegrees = _make_unary("ToDegrees", jnp.degrees, np.degrees)
 ToRadians = _make_unary("ToRadians", jnp.radians, np.radians)
+Sinh = _make_unary("Sinh", jnp.sinh, np.sinh)
+Cosh = _make_unary("Cosh", jnp.cosh, np.cosh)
+Tanh = _make_unary("Tanh", jnp.tanh, np.tanh)
+Asinh = _make_unary("Asinh", jnp.arcsinh, np.arcsinh)
+Acosh = _make_unary("Acosh", jnp.arccosh, np.arccosh)
+Atanh = _make_unary("Atanh", jnp.arctanh, np.arctanh)
+Cot = _make_unary("Cot", lambda x: 1.0 / jnp.tan(x),
+                  lambda x: 1.0 / np.tan(x))
 
 
 class Pow(BinaryExpression):
@@ -114,3 +122,24 @@ class Round(UnaryExpression):
         with np.errstate(all="ignore"):
             r = np.sign(x) * np.floor(np.abs(x) * m + 0.5) / m
         return CpuVal(self.dtype, r.astype(self.dtype.np_dtype), v.validity)
+
+
+class Logarithm(BinaryExpression):
+    """log(base, x) (Spark Logarithm, mathExpressions.scala)."""
+
+    def _resolve_type(self):
+        self.dtype = T.DOUBLE
+        self.nullable = True
+
+    def tpu_eval(self, ctx):
+        b = cast_dev(self.left.tpu_eval(ctx), T.DOUBLE)
+        x = cast_dev(self.right.tpu_eval(ctx), T.DOUBLE)
+        return DevVal(T.DOUBLE, jnp.log(x.data) / jnp.log(b.data),
+                      b.validity & x.validity)
+
+    def cpu_eval(self, ctx):
+        b = cast_cpu(self.left.cpu_eval(ctx), T.DOUBLE)
+        x = cast_cpu(self.right.cpu_eval(ctx), T.DOUBLE)
+        with np.errstate(all="ignore"):
+            data = np.log(x.values) / np.log(b.values)
+        return CpuVal(T.DOUBLE, data, b.validity & x.validity)

@@ -117,6 +117,9 @@ class CreateArray(Expression):
         return CreateArray(*children)
 
     def tpu_supported(self, conf):
+        if self.dtype.element.is_string:
+            return ("array<string> has variable-length elements "
+                    "(host-only in the v1 nested envelope)")
         if any(c.nullable for c in self.children):
             return ("array() with nullable inputs can produce NULL "
                     "elements (host-only in the v1 nested envelope)")

@@ -128,3 +128,47 @@ class NaNvl(Expression):
         data = np.where(nan, b.values, a.values)
         validity = np.where(nan, b.validity, a.validity)
         return CpuVal(T.DOUBLE, data, validity.astype(np.bool_))
+
+
+class AtLeastNNonNulls(Expression):
+    """True when >= n of the children are non-null (and non-NaN for
+    floats) — the predicate behind DataFrame.dropna (Spark
+    AtLeastNNonNulls)."""
+
+    def __init__(self, n: int, *children: Expression):
+        self.n = int(n)
+        self.children = tuple(children)
+        self._resolve_type()
+
+    def with_children(self, children):
+        return AtLeastNNonNulls(self.n, *children)
+
+    def _resolve_type(self):
+        self.dtype = T.BOOLEAN
+        self.nullable = False
+
+    def tpu_eval(self, ctx) -> DevVal:
+        total = None
+        for c in self.children:
+            v = c.tpu_eval(ctx)
+            valid = v.validity
+            if c.dtype in (T.FLOAT, T.DOUBLE):
+                safe = jnp.where(valid, v.data, 0)
+                valid = valid & ~jnp.isnan(safe)
+            cnt = valid.astype(jnp.int32)
+            total = cnt if total is None else total + cnt
+        return DevVal(T.BOOLEAN, total >= self.n,
+                      jnp.ones_like(total, dtype=jnp.bool_))
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        total = None
+        for c in self.children:
+            v = c.cpu_eval(ctx)
+            valid = v.validity
+            if c.dtype in (T.FLOAT, T.DOUBLE):
+                safe = np.where(valid, v.values, 0.0)
+                valid = valid & ~np.isnan(safe)
+            cnt = valid.astype(np.int32)
+            total = cnt if total is None else total + cnt
+        return CpuVal(T.BOOLEAN, total >= self.n,
+                      np.ones_like(total, dtype=np.bool_))

@@ -1116,3 +1116,167 @@ class ConcatWs(Expression):
             pieces = [str(v.values[i]) for v in vals if v.validity[i]]
             out[i] = self.sep.join(pieces)
         return CpuVal(T.STRING, out, np.ones(n, dtype=np.bool_))
+
+
+class InitCap(_CaseMap):
+    """initcap: lowercase everything, uppercase the first letter of each
+    whitespace-separated word (Spark InitCap / GpuInitCap)."""
+
+    def tpu_eval(self, ctx) -> DevVal:
+        v = self.child.tpu_eval(ctx)
+        data = v.data
+        nbytes = int(data.shape[0])
+        # word starts: first byte of each row (scatter of row offsets)
+        # or a byte following a space
+        starts = jnp.zeros(nbytes + 1, dtype=jnp.bool_) \
+            .at[jnp.clip(v.offsets, 0, nbytes)].set(True)[:nbytes]
+        after_space = jnp.concatenate(
+            [jnp.ones(1, dtype=jnp.bool_), data[:-1] == 32])
+        head = starts | after_space
+        is_upper = (data >= 65) & (data <= 90)
+        is_lower = (data >= 97) & (data <= 122)
+        lowered = jnp.where(is_upper, data + 32, data)
+        out = jnp.where(head & is_lower, data - 32,
+                        jnp.where(~head & is_upper, lowered, data))
+        return DevVal(T.STRING, out.astype(jnp.uint8), v.validity,
+                      v.offsets)
+
+    def _map_cpu(self, s):
+        # ASCII-only, matching the device byte mapping (same convention
+        # as Upper/Lower above)
+        out = []
+        head = True
+        for ch in s:
+            if head and "a" <= ch <= "z":
+                out.append(chr(ord(ch) - 32))
+            elif not head and "A" <= ch <= "Z":
+                out.append(chr(ord(ch) + 32))
+            else:
+                out.append(ch)
+            head = ch == " "
+        return "".join(out)
+
+
+class SubstringIndex(Expression):
+    """substring_index(str, delim, count): prefix before the count-th
+    delimiter (count > 0) or suffix after the |count|-th-from-the-right
+    delimiter (count < 0); whole string when not enough delimiters
+    (Spark SubstringIndex / GpuSubstringIndex)."""
+
+    def __init__(self, child: Expression, delimiter, count: int):
+        if not isinstance(delimiter, Expression):
+            delimiter = Literal(str(delimiter), T.STRING)
+        self.children = (child, delimiter)
+        self.count = int(count)
+        self.dtype = T.STRING
+        self.nullable = child.nullable
+
+    def with_children(self, children):
+        return SubstringIndex(children[0], children[1], self.count)
+
+    def tpu_supported(self, conf):
+        d = _literal_needle(self.children[1])
+        if d is None or d == "":
+            return "substring_index delimiter must be a non-empty literal"
+        if _has_self_overlap(d.encode("utf-8")):
+            return "substring_index delimiter can self-overlap (CPU only)"
+        return None
+
+    def tpu_eval(self, ctx) -> DevVal:
+        v = self.children[0].tpu_eval(ctx)
+        delim = _literal_needle(self.children[1]).encode("utf-8")
+        Ld = len(delim)
+        cap = v.capacity
+        nbytes = int(v.data.shape[0])
+        row_start, row_end = v.offsets[:-1], v.offsets[1:]
+        if self.count == 0:
+            zero = jnp.zeros(cap, dtype=jnp.int32)
+            return _gather_substring(v, zero, zero, nbytes, v.validity)
+        match = _find_matches(v, delim)
+        rows = jnp.clip(rows_of_positions(v.offsets, nbytes), 0, cap - 1)
+        pos = jnp.arange(nbytes, dtype=jnp.int32)
+        starts_i = match.astype(jnp.int32)
+        csum = jnp.concatenate([jnp.zeros(1, dtype=jnp.int32),
+                                jnp.cumsum(starts_i)])
+        rank = csum[pos] - csum[jnp.clip(v.offsets[rows], 0, nbytes)]
+        n_matches = jax.ops.segment_sum(starts_i, rows, num_segments=cap,
+                                        indices_are_sorted=True)
+        big = jnp.int32(1 << 30)
+        if self.count > 0:
+            # byte position of the (count-1)-th match per row
+            sel = match & (rank == self.count - 1)
+            kpos = jax.ops.segment_min(jnp.where(sel, pos, big), rows,
+                                       num_segments=cap,
+                                       indices_are_sorted=True)
+            start = row_start
+            end = jnp.where(n_matches >= self.count, kpos, row_end)
+        else:
+            # match index n_matches + count (0-based from the left)
+            k = n_matches + self.count  # per-row target rank
+            sel = match & (rank == k[rows])
+            kpos = jax.ops.segment_min(jnp.where(sel, pos, big), rows,
+                                       num_segments=cap,
+                                       indices_are_sorted=True)
+            start = jnp.where(n_matches >= -self.count, kpos + Ld,
+                              row_start)
+            end = row_end
+        new_lens = jnp.maximum(end - start, 0)
+        new_lens = jnp.where(v.validity & ctx.row_mask, new_lens, 0)
+        rel_start = (start - row_start).astype(jnp.int32)
+        return _gather_substring(v, rel_start, new_lens, nbytes,
+                                 v.validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        v = self.children[0].cpu_eval(ctx)
+        d = _literal_needle(self.children[1])
+        if d is None:
+            raise NotImplementedError(
+                "substring_index delimiter must be a literal")
+        out = np.empty(len(v.values), dtype=object)
+        for i, s in enumerate(v.values):
+            s = str(s)
+            c = self.count
+            if c == 0 or not d:
+                out[i] = ""
+            elif c > 0:
+                parts = s.split(d)
+                out[i] = d.join(parts[:c]) if len(parts) > c else s
+            else:
+                parts = s.split(d)
+                out[i] = d.join(parts[c:]) if len(parts) > -c else s
+        return CpuVal(T.STRING, out, v.validity)
+
+
+class StringSplit(Expression):
+    """split(str, delim) -> array<string> (Spark StringSplit).  The
+    engine's array columns hold fixed-width elements, so an array of
+    variable-length strings cannot live on the device — this expression
+    always runs on the CPU engine (planner fallback), like any
+    type-unsupported expression in the reference.  The delimiter is a
+    regex, matching Spark's split()."""
+
+    def __init__(self, child: Expression, delimiter):
+        if not isinstance(delimiter, Expression):
+            delimiter = Literal(str(delimiter), T.STRING)
+        self.children = (child, delimiter)
+        self.dtype = T.ArrayType(T.STRING)
+        self.nullable = child.nullable
+
+    def with_children(self, children):
+        return StringSplit(children[0], children[1])
+
+    def tpu_supported(self, conf):
+        return ("split produces array<string>; variable-length array "
+                "elements are CPU-only")
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        v = self.children[0].cpu_eval(ctx)
+        d = _literal_needle(self.children[1])
+        if d is None:
+            raise NotImplementedError("split delimiter must be a literal")
+        import re
+        pat = re.compile(d) if d else None
+        out = np.empty(len(v.values), dtype=object)
+        for i, s in enumerate(v.values):
+            out[i] = pat.split(str(s)) if pat else [str(s)]
+        return CpuVal(self.dtype, out, v.validity)

@@ -171,6 +171,49 @@ class CaseBuilder:
         return self.column.expr
 
 
+def sinh(c) -> Column:
+    from spark_rapids_tpu.exprs.mathexprs import Sinh
+    return _unary(Sinh, c)
+
+
+def cosh(c) -> Column:
+    from spark_rapids_tpu.exprs.mathexprs import Cosh
+    return _unary(Cosh, c)
+
+
+def tanh(c) -> Column:
+    from spark_rapids_tpu.exprs.mathexprs import Tanh
+    return _unary(Tanh, c)
+
+
+def cot(c) -> Column:
+    from spark_rapids_tpu.exprs.mathexprs import Cot
+    return _unary(Cot, c)
+
+
+def initcap(c) -> Column:
+    from spark_rapids_tpu.exprs.strings import InitCap
+    return _unary(InitCap, c)
+
+
+def weekday(c) -> Column:
+    from spark_rapids_tpu.exprs.datetime import WeekDay
+    return _unary(WeekDay, c)
+
+
+def substring_index(c, delimiter: str, count: int) -> Column:
+    from spark_rapids_tpu.exprs.strings import SubstringIndex
+    c = col(c) if isinstance(c, str) else c
+    return Column(SubstringIndex(_to_expr(c), delimiter, count))
+
+
+def split(c, delimiter: str) -> Column:
+    """split -> array<string>; CPU-only (variable-length elements)."""
+    from spark_rapids_tpu.exprs.strings import StringSplit
+    c = col(c) if isinstance(c, str) else c
+    return Column(StringSplit(_to_expr(c), delimiter))
+
+
 def upper(c) -> Column:
     from spark_rapids_tpu.exprs.strings import Upper
     return _unary(Upper, c)

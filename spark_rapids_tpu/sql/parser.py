@@ -375,7 +375,8 @@ class Parser:
         if self.accept("op", "-"):
             return UnaryMinus(self.parse_unary())
         if self.accept("op", "+"):
-            return self.parse_unary()
+            from spark_rapids_tpu.exprs.arithmetic import UnaryPositive
+            return UnaryPositive(self.parse_unary())
         return self.parse_primary()
 
     def parse_primary(self) -> Expression:
@@ -527,20 +528,44 @@ def _build_function(name: str, args: List[Expression], star: bool,
         "log": M.Log, "log2": M.Log2, "log10": M.Log10, "floor": M.Floor,
         "ceil": M.Ceil, "ceiling": M.Ceil, "sin": M.Sin, "cos": M.Cos,
         "tan": M.Tan, "asin": M.Asin, "acos": M.Acos, "atan": M.Atan,
-        "signum": M.Signum, "sign": M.Signum,
+        "signum": M.Signum, "sign": M.Signum, "sinh": M.Sinh,
+        "cosh": M.Cosh, "tanh": M.Tanh, "asinh": M.Asinh,
+        "acosh": M.Acosh, "atanh": M.Atanh, "cot": M.Cot,
         "upper": S.Upper, "ucase": S.Upper, "lower": S.Lower,
+        "initcap": S.InitCap,
         "lcase": S.Lower, "length": S.Length, "char_length": S.Length,
         "trim": S.StringTrim, "ltrim": S.StringTrimLeft,
         "rtrim": S.StringTrimRight,
         "year": D.Year, "month": D.Month, "day": D.DayOfMonth,
         "dayofmonth": D.DayOfMonth, "dayofweek": D.DayOfWeek,
         "dayofyear": D.DayOfYear, "quarter": D.Quarter, "hour": D.Hour,
+        "weekday": D.WeekDay,
         "minute": D.Minute, "second": D.Second,
         "isnull": N.IsNull, "isnan": N.IsNan,
     }
     if name == "abs":
         from spark_rapids_tpu.exprs.arithmetic import Abs
         return Abs(args[0])
+    if name == "log" and len(args) == 2:
+        return M.Logarithm(args[0], args[1])
+    if name == "substring_index":
+        from spark_rapids_tpu.exprs.arithmetic import UnaryMinus
+        from spark_rapids_tpu.exprs.base import Literal as _Lit
+        cnt = None
+        if len(args) == 3:
+            if isinstance(args[2], _Lit):
+                cnt = int(args[2].value)
+            elif isinstance(args[2], UnaryMinus) and \
+                    isinstance(args[2].child, _Lit):
+                cnt = -int(args[2].child.value)
+        if cnt is None:
+            raise SyntaxError(
+                "substring_index(str, delim, count) needs a literal count")
+        return S.SubstringIndex(args[0], args[1], cnt)
+    if name == "split":
+        if len(args) != 2:
+            raise SyntaxError("split(str, delimiter) takes two arguments")
+        return S.StringSplit(args[0], args[1])
     if name == "percentile":
         from spark_rapids_tpu.exprs.base import Literal
         if len(args) != 2 or not isinstance(args[1], Literal) \
@@ -607,6 +632,8 @@ def _build_function(name: str, args: List[Expression], star: bool,
         return cls(args[0], args[1].value, pad)
     if name == "unix_timestamp":
         return D.UnixTimestamp(args[0])
+    if name == "to_unix_timestamp":
+        return D.ToUnixTimestamp(args[0])
     if name == "from_unixtime":
         if len(args) > 1:
             return D.FromUnixTime(args[0], args[1].value)

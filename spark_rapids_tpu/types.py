@@ -162,11 +162,13 @@ class ArrayType(DataType):
     np_dtype = np.object_
 
     def __init__(self, element: DataType):
-        assert element.jnp_dtype is not None and not element.is_string and \
+        assert element.jnp_dtype is not None and \
             not isinstance(element, ArrayType), \
             f"unsupported array element type: {element}"
         self.element = element
-        self.jnp_dtype = element.jnp_dtype
+        # array<string> exists only on the host (CPU-engine results of
+        # e.g. split()); device layout needs fixed-width elements
+        self.jnp_dtype = None if element.is_string else element.jnp_dtype
 
     @property
     def name(self) -> str:

@@ -1,0 +1,149 @@
+"""Round-4 expression-parity additions: hyperbolics, cot, log(base, x),
+weekday, to_unix_timestamp, time-add, initcap, substring_index, split,
+unary plus, AtLeastNNonNulls/dropna (closing the GpuOverrides registry
+diff vs GpuOverrides.scala's expr[] list)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu import types as T
+
+from compare import assert_tpu_cpu_equal, tpu_session
+
+NUM = {"x": (T.DOUBLE, [0.5, -1.25, 2.0, None, 0.0]),
+       "b": (T.DOUBLE, [2.0, 10.0, 2.718281828, 3.0, 2.0]),
+       "n": (T.INT, [1, 2, None, 4, 5])}
+
+STR = {"s": (T.STRING, ["hello world", "a-b-c-d", "UPPER case",
+             None, "  padded  x", "www.apache.org"])}
+
+
+def test_hyperbolics_and_cot():
+    def build(s):
+        df = s.create_dataframe(NUM, num_partitions=2)
+        return df.select(
+            F.sinh("x").alias("sh"), F.cosh("x").alias("ch"),
+            F.tanh("x").alias("th"), F.cot("b").alias("ct"))
+
+    assert_tpu_cpu_equal(build, approx=True, ignore_order=False)
+
+
+def test_hyperbolics_sql_and_log_base():
+    def build(s):
+        s.register_view("t", s.create_dataframe(NUM, num_partitions=2))
+        return s.sql(
+            "SELECT sinh(x) AS a, asinh(x) AS b, acosh(b) AS c, "
+            "atanh(x / 10.0) AS d, log(2.0, b) AS e FROM t")
+
+    assert_tpu_cpu_equal(build, approx=True, ignore_order=False)
+
+
+def test_initcap():
+    def build(s):
+        s.register_view("t", s.create_dataframe(STR, num_partitions=2))
+        return s.sql("SELECT initcap(s) AS c FROM t")
+
+    assert_tpu_cpu_equal(build, approx=True, ignore_order=False)
+
+
+def test_initcap_ground_truth():
+    s = tpu_session()
+    df = s.create_dataframe(STR, num_partitions=2)
+    rows = [r[0] for r in df.select(F.initcap("s").alias("c")).collect()]
+    assert rows[0] == "Hello World"
+    assert rows[2] == "Upper Case"
+    assert rows[3] is None
+    assert rows[4] == "  Padded  X"
+
+
+@pytest.mark.parametrize("count", [1, 2, 3, 10, -1, -2, -10, 0])
+def test_substring_index(count):
+    def build(s, count=count):
+        s.register_view("t", s.create_dataframe(STR, num_partitions=2))
+        return s.sql(
+            f"SELECT substring_index(s, '-', {count}) AS c FROM t")
+
+    assert_tpu_cpu_equal(build, approx=True, ignore_order=False)
+
+
+def test_substring_index_ground_truth():
+    s = tpu_session()
+    df = s.create_dataframe(STR, num_partitions=2)
+    got = [r[0] for r in df.select(
+        F.substring_index("s", ".", 2).alias("c")).collect()]
+    assert got[5] == "www.apache"
+    got = [r[0] for r in df.select(
+        F.substring_index("s", ".", -2).alias("c")).collect()]
+    assert got[5] == "apache.org"
+
+
+def test_split_falls_back_and_matches():
+    def build(s):
+        s.register_view("t", s.create_dataframe(STR, num_partitions=2))
+        return s.sql("SELECT split(s, '-') AS parts FROM t")
+
+    assert_tpu_cpu_equal(build, approx=True, ignore_order=False,
+                         expect_fallback="split")
+
+
+def test_weekday_and_to_unix_timestamp():
+    data = {"d": (T.DATE, [0, 1, 2, 3, 4, 5, 6, None, 11323])}
+
+    def build(s):
+        s.register_view("t", s.create_dataframe(data, num_partitions=2))
+        return s.sql("SELECT weekday(d) AS w, dayofweek(d) AS dw, "
+                     "to_unix_timestamp(d) AS ut FROM t")
+
+    assert_tpu_cpu_equal(build, approx=True, ignore_order=False)
+
+    s = tpu_session()
+    df = s.create_dataframe(data, num_partitions=1)
+    rows = df.select(F.weekday("d").alias("w")).collect()
+    # 1970-01-01 (day 0) was a Thursday -> weekday 3 (0 = Monday)
+    assert rows[0][0] == 3 and rows[3][0] == 6 and rows[4][0] == 0
+
+
+def test_time_add():
+    from spark_rapids_tpu.dataframe import Column
+    from spark_rapids_tpu.exprs.datetime import TimeAdd
+
+    def build(s):
+        df = s.create_dataframe(
+            {"ts": (T.TIMESTAMP, [0, 86_400_000_000, None])},
+            num_partitions=1)
+        return df.select(Column(TimeAdd(
+            df["ts"].expr, 3_600_000_000)).alias("plus1h"))
+
+    assert_tpu_cpu_equal(build, approx=True, ignore_order=False)
+
+
+def test_unary_positive_sql():
+    def build(s):
+        s.register_view("t", s.create_dataframe(NUM, num_partitions=2))
+        return s.sql("SELECT +x AS px, -x AS nx FROM t")
+
+    assert_tpu_cpu_equal(build, approx=True, ignore_order=False)
+
+
+def test_dropna():
+    data = {"a": (T.INT, [1, None, 3, None, 5]),
+            "f": (T.DOUBLE, [1.0, 2.0, float("nan"), None, 5.0])}
+
+    def build_any(s):
+        return s.create_dataframe(data, num_partitions=2).dropna()
+
+    def build_all(s):
+        return s.create_dataframe(data, num_partitions=2).dropna("all")
+
+    def build_thresh(s):
+        return s.create_dataframe(data, num_partitions=2).dropna(
+            thresh=1, subset=["f"])
+
+    assert_tpu_cpu_equal(build_any, ignore_order=False)
+    assert_tpu_cpu_equal(build_all, ignore_order=False)
+    assert_tpu_cpu_equal(build_thresh, ignore_order=False)
+
+    s = tpu_session()
+    rows = s.create_dataframe(data, num_partitions=1).dropna().collect()
+    assert rows == [(1, 1.0), (5, 5.0)]
