@@ -11,7 +11,8 @@ HAVING-style post-filter (q65 family), windowed category shares
 (q53/q89/q98), year-over-year self joins (q2/q59), rollup-via-union
 (q22), three-branch channel unions (q14/q33), running cumulative windows
 (q51), semi-join frequent-buyer selection (q34), premium-vs-average
-subquery joins (q92), and return-adjusted left joins (q93).
+subquery joins (q92), return-adjusted left joins (q93), and INTERSECT/
+EXCEPT customer-overlap counts (q38/q87).
 """
 
 from __future__ import annotations
@@ -575,10 +576,33 @@ ORDER BY sumsales DESC, ss_customer_sk
 LIMIT 100
 """
 
+Q38 = """
+SELECT count(*) AS common_customers
+FROM (
+  SELECT ss_customer_sk FROM store_sales
+  JOIN date_dim ON d_date_sk = ss_sold_date_sk
+  WHERE d_moy BETWEEN 1 AND 6
+  INTERSECT
+  SELECT ss_customer_sk FROM store_sales
+  JOIN date_dim ON d_date_sk = ss_sold_date_sk
+  WHERE d_moy BETWEEN 7 AND 12
+)
+"""
+
+Q87 = """
+SELECT count(*) AS never_returned
+FROM (
+  SELECT ss_customer_sk FROM store_sales
+  EXCEPT
+  SELECT sr_customer_sk FROM store_returns
+)
+"""
+
 QUERIES = {"q3": Q3, "q7": Q7, "q13": Q13, "q14": Q14, "q19": Q19,
            "q26": Q26, "q29": Q29, "q36": Q36, "q42": Q42, "q43": Q43,
            "q48": Q48, "q52": Q52, "q53": Q53, "q55": Q55, "q59": Q59,
            "q61": Q61, "q65": Q65, "q68": Q68, "q73": Q73, "q79": Q79,
            "q89": Q89, "q98": Q98,
            "q2": Q2, "q22": Q22, "q25": Q25, "q33": Q33,
-           "q34": Q34, "q51": Q51, "q92": Q92, "q93": Q93}
+           "q34": Q34, "q51": Q51, "q92": Q92, "q93": Q93,
+           "q38": Q38, "q87": Q87}

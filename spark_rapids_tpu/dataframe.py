@@ -451,6 +451,44 @@ class DataFrame:
 
     unionAll = union
 
+    def _set_op(self, other: "DataFrame", keep) -> "DataFrame":
+        """INTERSECT/EXCEPT (distinct set semantics) via union + group-by:
+        grouping keys already treat NULLs (and NaNs) as equal, which is
+        exactly the SQL set-operation equality — and the plan rides the
+        hash-aggregate path instead of a null-safe join (Spark plans
+        these as left-semi/anti joins; the aggregate form is the
+        TPU-friendly equivalent)."""
+        from spark_rapids_tpu import functions as F
+        if len(self.columns) != len(other.columns):
+            raise ValueError(
+                f"set operation needs equal column counts: "
+                f"{len(self.columns)} vs {len(other.columns)}")
+        side = "__setop_side"
+        right = other.select(*[
+            other[c2].alias(c1)
+            for c1, c2 in zip(self.columns, other.columns)])
+        # No per-side distinct: the min/max group-by is insensitive to
+        # row multiplicity, so one aggregation collapses everything.
+        u = (self.with_column(side, F.lit(0))
+             .union(right.with_column(side, F.lit(1))))
+        g = (u.group_by(*self.columns)
+             .agg(F.min(side).alias("__mn"), F.max(side).alias("__mx")))
+        mn, mx = g["__mn"], g["__mx"]
+        cond = (mn == 0) & (mx == 1) if keep == "both" else \
+            (mn == 0) & (mx == 0)
+        return g.filter(cond).select(*self.columns)
+
+    def intersect(self, other: "DataFrame") -> "DataFrame":
+        """Distinct rows present in BOTH frames (SQL INTERSECT)."""
+        return self._set_op(other, "both")
+
+    def subtract(self, other: "DataFrame") -> "DataFrame":
+        """Distinct rows of this frame absent from ``other`` (SQL
+        EXCEPT; pyspark subtract/exceptAll's distinct sibling)."""
+        return self._set_op(other, "left")
+
+    exceptDistinct = subtract
+
     def dropna(self, how: str = "any", thresh: Optional[int] = None,
                subset: Optional[List[str]] = None) -> "DataFrame":
         """Drop rows with null/NaN values (pyspark DataFrame.na.drop;

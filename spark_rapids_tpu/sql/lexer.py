@@ -10,7 +10,8 @@ KEYWORDS = {
     "select", "from", "where", "group", "by", "having", "order", "limit",
     "as", "and", "or", "not", "in", "is", "null", "like", "between",
     "join", "inner", "left", "right", "full", "outer", "semi", "anti",
-    "cross", "on", "using", "union", "all", "distinct", "case", "when",
+    "cross", "on", "using", "union", "all", "distinct", "intersect",
+    "except", "case", "when",
     "then", "else", "end", "asc", "desc", "nulls", "first", "last", "cast",
     "true", "false", "exists", "interval", "over", "partition", "rows",
     "range", "unbounded", "preceding", "following", "current", "row",

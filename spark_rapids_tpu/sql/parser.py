@@ -63,12 +63,42 @@ class Parser:
 
     # -- entry --------------------------------------------------------------
 
-    def parse_query(self):
+    def _setop_qualifier(self, op: str) -> bool:
+        """Parse [ALL | DISTINCT] after a set-op keyword; True = ALL."""
+        has_all = bool(self.accept("keyword", "all"))
+        has_distinct = bool(self.accept("keyword", "distinct"))
+        if has_all and has_distinct:
+            raise SyntaxError(f"{op.upper()} ALL DISTINCT is contradictory")
+        return has_all
+
+    def parse_set_term(self):
+        """select [INTERSECT select]* — INTERSECT binds tighter than
+        UNION/EXCEPT (SQL precedence)."""
         df = self.parse_select()
-        while self.at_kw("union"):
+        while self.at_kw("intersect"):
             self.next()
-            self.expect("keyword", "all")
-            df = df.union(self.parse_select())
+            if self._setop_qualifier("intersect"):
+                raise NotImplementedError(
+                    "INTERSECT ALL (multiset semantics) is not "
+                    "supported; use INTERSECT [DISTINCT]")
+            df = df.intersect(self.parse_select())
+        return df
+
+    def parse_query(self):
+        df = self.parse_set_term()
+        while self.at_kw("union", "except"):
+            op = self.next().value
+            has_all = self._setop_qualifier(op)
+            if op == "union":
+                df = df.union(self.parse_set_term())
+                if not has_all:
+                    df = df.distinct()
+            elif has_all:
+                raise NotImplementedError(
+                    "EXCEPT ALL (multiset semantics) is not supported; "
+                    "use EXCEPT [DISTINCT]")
+            else:
+                df = df.subtract(self.parse_set_term())
         if self.at_kw("order"):
             self.next()
             self.expect("keyword", "by")

@@ -1,0 +1,112 @@
+"""INTERSECT / EXCEPT set operations (Spark plans these as null-safe
+left-semi/anti joins; the engine rewrites them onto the hash-aggregate
+path — group keys already give the set-op NULL-equality)."""
+
+import pytest
+
+from spark_rapids_tpu import types as T
+
+from compare import assert_tpu_cpu_equal, tpu_session
+
+A = {"k": (T.INT, [1, 1, 2, 3, None, 5]),
+     "s": (T.STRING, ["a", "a", "b", "c", None, "e"])}
+B = {"k": (T.INT, [1, 3, None, 7]),
+     "s": (T.STRING, ["a", "c", None, "g"])}
+
+
+def _frames(s):
+    return (s.create_dataframe(A, num_partitions=2),
+            s.create_dataframe(B, num_partitions=2))
+
+
+def test_intersect_dataframe():
+    def build(s):
+        a, b = _frames(s)
+        return a.intersect(b).order_by("k", "s")
+
+    assert_tpu_cpu_equal(build, ignore_order=False)
+    s = tpu_session()
+    a, b = _frames(s)
+    rows = a.intersect(b).order_by("k").collect()
+    # NULL row matches NULL row (set-op equality), dups collapse;
+    # ascending sort puts NULLs first (Spark default)
+    assert rows == [(None, None), (1, "a"), (3, "c")]
+
+
+def test_subtract_dataframe():
+    def build(s):
+        a, b = _frames(s)
+        return a.subtract(b).order_by("k", "s")
+
+    assert_tpu_cpu_equal(build, ignore_order=False)
+    s = tpu_session()
+    a, b = _frames(s)
+    rows = a.subtract(b).order_by("k").collect()
+    assert rows == [(2, "b"), (5, "e")]
+
+
+def test_intersect_except_sql():
+    def build(s):
+        a, b = _frames(s)
+        s.register_view("a", a)
+        s.register_view("b", b)
+        return s.sql("SELECT k, s FROM a INTERSECT SELECT k, s FROM b "
+                     "ORDER BY k, s")
+
+    assert_tpu_cpu_equal(build, ignore_order=False)
+
+    def build2(s):
+        a, b = _frames(s)
+        s.register_view("a", a)
+        s.register_view("b", b)
+        return s.sql("SELECT k, s FROM a EXCEPT DISTINCT "
+                     "SELECT k, s FROM b ORDER BY k, s")
+
+    assert_tpu_cpu_equal(build2, ignore_order=False)
+
+
+def test_union_distinct_sql():
+    def build(s):
+        a, b = _frames(s)
+        s.register_view("a", a)
+        s.register_view("b", b)
+        return s.sql("SELECT k, s FROM a UNION SELECT k, s FROM b "
+                     "ORDER BY k, s")
+
+    assert_tpu_cpu_equal(build, ignore_order=False)
+
+
+def test_set_op_column_count_mismatch():
+    s = tpu_session()
+    a, _ = _frames(s)
+    with pytest.raises(ValueError):
+        a.intersect(a.select("k"))
+
+
+def test_intersect_all_rejected():
+    s = tpu_session()
+    a, b = _frames(s)
+    s.register_view("a", a)
+    s.register_view("b", b)
+    with pytest.raises(NotImplementedError):
+        s.sql("SELECT k FROM a INTERSECT ALL SELECT k FROM b")
+
+
+def test_intersect_binds_tighter_than_union():
+    """a UNION (b INTERSECT c), per SQL precedence — not (a UNION b)
+    INTERSECT c."""
+    s = tpu_session()
+    for name, vals in (("ta", [1]), ("tb", [2]), ("tc", [2])):
+        s.register_view(name, s.create_dataframe(
+            {"k": (T.INT, vals)}, num_partitions=1))
+    rows = s.sql("SELECT k FROM ta UNION SELECT k FROM tb "
+                 "INTERSECT SELECT k FROM tc ORDER BY k").collect()
+    assert rows == [(1,), (2,)]
+
+
+def test_union_all_distinct_rejected():
+    s = tpu_session()
+    s.register_view("ta", s.create_dataframe(
+        {"k": (T.INT, [1])}, num_partitions=1))
+    with pytest.raises(SyntaxError):
+        s.sql("SELECT k FROM ta UNION ALL DISTINCT SELECT k FROM ta")
