@@ -307,6 +307,33 @@ class DataFrame:
 
     groupBy = group_by
 
+    def _grouping_sets(self, cols, sets) -> "GroupingSetsData":
+        gd = self.group_by(*cols)
+        n = len(gd.keys)
+        for s in sets:
+            bad = [i for i in s if not (0 <= i < n)]
+            if bad:
+                raise ValueError(
+                    f"grouping set {s} references key positions {bad}; "
+                    f"only {n} grouping keys exist")
+        return GroupingSetsData(self, gd.keys, gd.names,
+                                [tuple(s) for s in sets])
+
+    def rollup(self, *cols) -> "GroupingSetsData":
+        """GROUP BY ROLLUP: hierarchical subtotal grouping sets
+        ((k1..kn), (k1..kn-1), ..., ()) over the Expand exec
+        (GpuExpandExec's grouping-sets role)."""
+        return self._grouping_sets(cols, rollup_sets(len(cols)))
+
+    def cube(self, *cols) -> "GroupingSetsData":
+        """GROUP BY CUBE: every subset of the grouping keys."""
+        return self._grouping_sets(cols, cube_sets(len(cols)))
+
+    def grouping_sets(self, cols, sets) -> "GroupingSetsData":
+        """Explicit GROUPING SETS: ``sets`` is a list of tuples of key
+        positions (indices into ``cols``)."""
+        return self._grouping_sets(cols, [tuple(s) for s in sets])
+
     def agg(self, *aggs) -> "DataFrame":
         return GroupedData(self, [], []).agg(*aggs)
 
@@ -992,3 +1019,80 @@ def _resolve_agg(fn: AggregateFunction, schema: T.Schema
                     schema)
     new = fn.with_children([child])
     return new
+
+
+GROUPING_ID_COL = "__grouping_id"
+GROUPING_SET_COL = "__gset_idx"
+
+
+def rollup_sets(n: int):
+    """((0..n-1), (0..n-2), ..., ()) — the ROLLUP ladder.  Ordering is
+    bit-layout-sensitive: grouping_id bit (n-1-i) marks key i masked."""
+    return [tuple(range(k)) for k in range(n, -1, -1)]
+
+
+def cube_sets(n: int):
+    """Every subset of the n grouping keys (CUBE)."""
+    import itertools
+    return [s for k in range(n, -1, -1)
+            for s in itertools.combinations(range(n), k)]
+
+
+class GroupingSetsData(GroupedData):
+    """GroupedData over ROLLUP / CUBE / GROUPING SETS: plans an Expand
+    producing one copy of the input per grouping set — original columns
+    passed through for the aggregates, key columns masked to NULL where
+    grouped-out, plus a grouping_id — then a single hash aggregation
+    over (masked keys, grouping_id).  The reference's GpuExpandExec
+    exists for exactly this plan shape (GpuExpandExec.scala)."""
+
+    def __init__(self, df: DataFrame, keys: List[Expression],
+                 names: List[str], sets: List[tuple]):
+        super().__init__(df, keys, names)
+        self.sets = sets
+
+    def agg(self, *aggs) -> DataFrame:
+        from spark_rapids_tpu import functions as F
+        from spark_rapids_tpu.exprs.aggregates import GroupingID
+        n = len(self.keys)
+        child_fields = self.df.schema.fields
+        masked = [f"__gset_k{i}" for i in range(n)]
+        projections, names = [], None
+        # The set INDEX (not the grouping_id) is the hidden group key:
+        # duplicate grouping sets must stay separate groups and emit
+        # duplicate result rows (Spark semantics, SPARK-33229) — their
+        # gids are equal, their indices are not.
+        for si, s in enumerate(self.sets):
+            gid = sum(1 << (n - 1 - i) for i in range(n) if i not in s)
+            proj = [ColumnRef(f.name, f.dtype, f.nullable)
+                    for f in child_fields]
+            for i, k in enumerate(self.keys):
+                proj.append(k if i in s else Literal(None, k.dtype))
+            proj.append(Literal(si, T.INT))
+            proj.append(Literal(gid, T.INT))
+            projections.append(proj)
+        names = [f.name for f in child_fields] + masked \
+            + [GROUPING_SET_COL, GROUPING_ID_COL]
+        expanded = DataFrame(
+            L.Expand(projections, names, self.df.plan), self.df.session)
+        inner_keys = [ColumnRef(mn, k.dtype, True)
+                      for mn, k in zip(masked, self.keys)]
+        inner_keys.append(ColumnRef(GROUPING_SET_COL, T.INT, False))
+        gd = GroupedData(expanded, inner_keys,
+                         self.names + [GROUPING_SET_COL])
+        fixed = []
+        for a in aggs:
+            e = a.expr if isinstance(a, Column) else None
+            name = None
+            if isinstance(e, Alias):
+                name, e = e.alias_name, e.children[0]
+            if isinstance(e, GroupingID):
+                fixed.append(F.min(Column(
+                    ColumnRef(GROUPING_ID_COL, T.INT, False)))
+                    .alias(name or "grouping_id"))
+            else:
+                fixed.append(a)
+        out = gd.agg(*fixed)
+        return out.select(*[c for c in out.columns
+                            if c not in (GROUPING_ID_COL,
+                                         GROUPING_SET_COL)])

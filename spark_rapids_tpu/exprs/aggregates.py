@@ -425,3 +425,27 @@ class AggregateExpression:
 
 def count_star() -> Count:
     return Count(Literal(1, T.INT))
+
+
+class GroupingID(AggregateFunction):
+    """grouping_id(): the bitmask of masked-out grouping keys under
+    ROLLUP/CUBE/GROUPING SETS (Spark GroupingID).  A marker the
+    grouping-sets rewrite replaces with min(__grouping_id) — reaching
+    execution unreplaced means it was used outside grouping sets."""
+
+    def __init__(self):
+        super().__init__(Literal(0, T.INT))
+
+    def with_children(self, children):
+        return GroupingID()
+
+    def _resolve_type(self):
+        self.dtype = T.INT
+        self.nullable = False
+
+    def tpu_supported(self, conf):
+        return None
+
+    def buffers(self):
+        raise AssertionError(
+            "grouping_id() is only valid under rollup/cube/grouping sets")

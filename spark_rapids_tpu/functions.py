@@ -64,6 +64,12 @@ def last(c, ignore_nulls: bool = False) -> Column:
     return Column(A.Last(e, ignore_nulls))
 
 
+def grouping_id() -> Column:
+    """Bitmask of masked-out keys under rollup/cube/grouping sets."""
+    from spark_rapids_tpu.exprs.aggregates import GroupingID
+    return Column(GroupingID())
+
+
 def percentile(c, percentage: float) -> Column:
     """Exact percentile with linear interpolation (Spark `percentile`);
     rewritten to a rank-and-interpolate pipeline at aggregation time."""

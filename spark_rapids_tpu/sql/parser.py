@@ -152,20 +152,63 @@ class Parser:
         if self.accept("keyword", "where"):
             where = self.parse_expr()
         group_by: Optional[List[Expression]] = None
+        group_sets = None  # None = plain GROUP BY; else list of index sets
         if self.accept("keyword", "group"):
             self.expect("keyword", "by")
-            group_by = [self.parse_expr()]
-            while self.accept("op", ","):
-                group_by.append(self.parse_expr())
+            if self.at_kw("rollup", "cube"):
+                kind = self.next().value
+                self.expect("op", "(")
+                group_by = [self.parse_expr()]
+                while self.accept("op", ","):
+                    group_by.append(self.parse_expr())
+                self.expect("op", ")")
+                from spark_rapids_tpu.dataframe import (
+                    cube_sets, rollup_sets,
+                )
+                n = len(group_by)
+                group_sets = rollup_sets(n) if kind == "rollup" \
+                    else cube_sets(n)
+            elif self.at_kw("grouping"):
+                self.next()
+                self.expect("keyword", "sets")
+                self.expect("op", "(")
+                raw_sets = []
+                keys: List[Expression] = []
+                while True:
+                    self.expect("op", "(")
+                    one = []
+                    if not (self.peek().kind == "op"
+                            and self.peek().value == ")"):
+                        one.append(self.parse_expr())
+                        while self.accept("op", ","):
+                            one.append(self.parse_expr())
+                    self.expect("op", ")")
+                    idxs = []
+                    for e in one:
+                        key = next((i for i, k in enumerate(keys)
+                                    if repr(k) == repr(e)), None)
+                        if key is None:
+                            key = len(keys)
+                            keys.append(e)
+                        idxs.append(key)
+                    raw_sets.append(tuple(idxs))
+                    if not self.accept("op", ","):
+                        break
+                self.expect("op", ")")
+                group_by, group_sets = keys, raw_sets
+            else:
+                group_by = [self.parse_expr()]
+                while self.accept("op", ","):
+                    group_by.append(self.parse_expr())
         having = None
         if self.accept("keyword", "having"):
             having = self.parse_expr()
 
         return self.build_select(df, star, projections, where, group_by,
-                                 having, distinct)
+                                 having, distinct, group_sets)
 
     def build_select(self, df, star, projections, where, group_by, having,
-                     distinct):
+                     distinct, group_sets=None):
         from spark_rapids_tpu.dataframe import Column
         from spark_rapids_tpu.exprs.base import output_name, resolve
         if where is not None:
@@ -177,7 +220,11 @@ class Parser:
             keys = [resolve(k, df.schema) for k in (group_by or [])]
             key_names = [output_name(k, i) for i, k in enumerate(keys)]
             key_map = {repr(k): nm for k, nm in zip(keys, key_names)}
-            gd = df.group_by(*[Column(k) for k in keys])
+            if group_sets is not None:
+                gd = df._grouping_sets([Column(k) for k in keys],
+                                       group_sets)
+            else:
+                gd = df.group_by(*[Column(k) for k in keys])
             aggs, post = [], []  # post: (output_name, expr-or-None)
             agg_map = {}  # repr(agg) -> output column name
             for idx, (e, name) in enumerate(projections):
@@ -596,6 +643,8 @@ def _build_function(name: str, args: List[Expression], star: bool,
         if len(args) != 2:
             raise SyntaxError("split(str, delimiter) takes two arguments")
         return S.StringSplit(args[0], args[1])
+    if name == "grouping_id":
+        return A.GroupingID()
     if name == "percentile":
         from spark_rapids_tpu.exprs.base import Literal
         if len(args) != 2 or not isinstance(args[1], Literal) \
