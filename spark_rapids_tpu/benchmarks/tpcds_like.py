@@ -598,6 +598,16 @@ FROM (
 )
 """
 
+Q67 = """
+SELECT i_category, i_brand, s_state, sum(ss_ext_sales_price) AS sales
+FROM store_sales
+JOIN item ON i_item_sk = ss_item_sk
+JOIN store ON s_store_sk = ss_store_sk
+GROUP BY ROLLUP(i_category, i_brand, s_state)
+ORDER BY i_category, i_brand, s_state, sales
+LIMIT 200
+"""
+
 QUERIES = {"q3": Q3, "q7": Q7, "q13": Q13, "q14": Q14, "q19": Q19,
            "q26": Q26, "q29": Q29, "q36": Q36, "q42": Q42, "q43": Q43,
            "q48": Q48, "q52": Q52, "q53": Q53, "q55": Q55, "q59": Q59,
@@ -605,4 +615,4 @@ QUERIES = {"q3": Q3, "q7": Q7, "q13": Q13, "q14": Q14, "q19": Q19,
            "q89": Q89, "q98": Q98,
            "q2": Q2, "q22": Q22, "q25": Q25, "q33": Q33,
            "q34": Q34, "q51": Q51, "q92": Q92, "q93": Q93,
-           "q38": Q38, "q87": Q87}
+           "q38": Q38, "q87": Q87, "q67": Q67}

@@ -516,6 +516,52 @@ class DataFrame:
 
     exceptDistinct = subtract
 
+    def fillna(self, value, subset: Optional[List[str]] = None
+               ) -> "DataFrame":
+        """Replace nulls — and NaNs in float columns — with ``value``
+        (pyspark DataFrame.na.fill): scalar applied to type-compatible
+        columns, or a {col: value} dict.  Fill values cast to the column
+        type (2.5 fills an INT column as 2, like pyspark); incompatible
+        columns are left untouched."""
+        from spark_rapids_tpu import functions as F
+
+        def check(v):
+            if isinstance(v, bool) or                     isinstance(v, (int, float, str)):
+                return v
+            raise TypeError(
+                "value should be a float, int, string, bool or dict, "
+                f"got {type(v).__name__}")
+
+        if isinstance(value, dict):
+            mapping = {c: check(v) for c, v in value.items()}
+            for c in mapping:
+                self.schema.field(c)  # raises on unknown columns
+        else:
+            check(value)
+            cols = subset or [f.name for f in self.schema.fields]
+            for c in cols:
+                self.schema.field(c)
+            mapping = {c: value for c in cols}
+        sel = []
+        for f in self.schema.fields:
+            v = mapping.get(f.name)
+            if v is not None and _fill_compatible(f.dtype, v):
+                if f.dtype.is_integral and isinstance(v, float) \
+                        and not isinstance(v, bool):
+                    v = int(v)  # pyspark casts the value to the column
+                filled = F.coalesce(self[f.name], F.lit(v))
+                if f.dtype in (T.FLOAT, T.DOUBLE):
+                    # pyspark na.fill replaces NaN too
+                    from spark_rapids_tpu.exprs.nullexprs import NaNvl
+                    filled = F.coalesce(
+                        Column(NaNvl(self[f.name].expr,
+                                     Literal(float(v), T.DOUBLE))),
+                        F.lit(v))
+                sel.append(filled.cast(f.dtype).alias(f.name))
+            else:
+                sel.append(self[f.name].alias(f.name))
+        return self.select(*sel)
+
     def dropna(self, how: str = "any", thresh: Optional[int] = None,
                subset: Optional[List[str]] = None) -> "DataFrame":
         """Drop rows with null/NaN values (pyspark DataFrame.na.drop;
@@ -1051,6 +1097,23 @@ class GroupingSetsData(GroupedData):
         super().__init__(df, keys, names)
         self.sets = sets
 
+    def apply_in_pandas(self, fn, schema) -> DataFrame:
+        raise NotImplementedError(
+            "apply_in_pandas under rollup/cube/grouping sets is not "
+            "supported (Spark has no pandas path for grouping sets "
+            "either); aggregate with agg() instead")
+
+    applyInPandas = apply_in_pandas
+
+    def agg_in_pandas(self, specs) -> DataFrame:
+        raise NotImplementedError(
+            "agg_in_pandas under rollup/cube/grouping sets is not "
+            "supported; aggregate with agg() instead")
+
+    def cogroup(self, other) -> "CoGroupedData":
+        raise NotImplementedError(
+            "cogroup under rollup/cube/grouping sets is not supported")
+
     def agg(self, *aggs) -> DataFrame:
         from spark_rapids_tpu import functions as F
         from spark_rapids_tpu.exprs.aggregates import GroupingID
@@ -1096,3 +1159,15 @@ class GroupingSetsData(GroupedData):
         return out.select(*[c for c in out.columns
                             if c not in (GROUPING_ID_COL,
                                          GROUPING_SET_COL)])
+
+
+def _fill_compatible(dtype: T.DataType, value) -> bool:
+    """pyspark fill rules: numeric fills numeric, string fills string,
+    bool fills bool; mismatches leave the column untouched."""
+    if isinstance(value, bool):
+        return dtype == T.BOOLEAN
+    if isinstance(value, (int, float)):
+        return dtype.is_numeric
+    if isinstance(value, str):
+        return dtype.is_string
+    return False

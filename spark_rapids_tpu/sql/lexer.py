@@ -11,7 +11,7 @@ KEYWORDS = {
     "as", "and", "or", "not", "in", "is", "null", "like", "between",
     "join", "inner", "left", "right", "full", "outer", "semi", "anti",
     "cross", "on", "using", "union", "all", "distinct", "intersect",
-    "except", "rollup", "cube", "grouping", "sets", "case", "when",
+    "except", "case", "when",
     "then", "else", "end", "asc", "desc", "nulls", "first", "last", "cast",
     "true", "false", "exists", "interval", "over", "partition", "rows",
     "range", "unbounded", "preceding", "following", "current", "row",

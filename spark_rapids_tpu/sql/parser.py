@@ -61,6 +61,13 @@ class Parser:
         t = self.peek()
         return t.kind == "keyword" and t.value in words
 
+    def _at_ident(self, *words: str) -> bool:
+        """Context-sensitive soft keyword: an identifier matching one of
+        ``words`` (ROLLUP/CUBE/GROUPING SETS are not reserved — a column
+        may be named rollup)."""
+        t = self.peek()
+        return t.kind == "ident" and t.value.lower() in words
+
     # -- entry --------------------------------------------------------------
 
     def _setop_qualifier(self, op: str) -> bool:
@@ -155,8 +162,9 @@ class Parser:
         group_sets = None  # None = plain GROUP BY; else list of index sets
         if self.accept("keyword", "group"):
             self.expect("keyword", "by")
-            if self.at_kw("rollup", "cube"):
-                kind = self.next().value
+            if self._at_ident("rollup", "cube") and \
+                    self.peek(1).value == "(":
+                kind = self.next().value.lower()
                 self.expect("op", "(")
                 group_by = [self.parse_expr()]
                 while self.accept("op", ","):
@@ -168,21 +176,27 @@ class Parser:
                 n = len(group_by)
                 group_sets = rollup_sets(n) if kind == "rollup" \
                     else cube_sets(n)
-            elif self.at_kw("grouping"):
+            elif self._at_ident("grouping") and \
+                    self.peek(1).kind == "ident" and \
+                    self.peek(1).value.lower() == "sets":
                 self.next()
-                self.expect("keyword", "sets")
+                self.next()
                 self.expect("op", "(")
                 raw_sets = []
                 keys: List[Expression] = []
                 while True:
-                    self.expect("op", "(")
                     one = []
-                    if not (self.peek().kind == "op"
-                            and self.peek().value == ")"):
-                        one.append(self.parse_expr())
-                        while self.accept("op", ","):
+                    if self.accept("op", "("):
+                        if not (self.peek().kind == "op"
+                                and self.peek().value == ")"):
                             one.append(self.parse_expr())
-                    self.expect("op", ")")
+                            while self.accept("op", ","):
+                                one.append(self.parse_expr())
+                        self.expect("op", ")")
+                    else:
+                        # bare expression = one-element set (Spark
+                        # shorthand: GROUPING SETS (a, (b, c), ()))
+                        one.append(self.parse_expr())
                     idxs = []
                     for e in one:
                         key = next((i for i, k in enumerate(keys)

@@ -147,3 +147,42 @@ def test_dropna():
     s = tpu_session()
     rows = s.create_dataframe(data, num_partitions=1).dropna().collect()
     assert rows == [(1, 1.0), (5, 5.0)]
+
+
+def test_fillna():
+    data = {"a": (T.INT, [1, None, 3]),
+            "s": (T.STRING, ["x", None, "z"]),
+            "f": (T.DOUBLE, [None, 2.0, None])}
+
+    import pytest as _pt
+    s0 = tpu_session()
+    df0 = s0.create_dataframe(data, num_partitions=1)
+    with _pt.raises(TypeError):
+        df0.fillna(None)
+    with _pt.raises(KeyError):
+        df0.fillna(0, subset=["nope"])
+    # float fill on an INT column casts to the column type (pyspark)
+    rows0 = df0.fillna(2.9).collect()
+    assert rows0[1][0] == 2 and isinstance(rows0[1][0], int)
+    # NaN in a float column is filled too
+    dfn = s0.create_dataframe(
+        {"f": (T.DOUBLE, [float("nan"), None, 1.0])}, num_partitions=1)
+    assert [r[0] for r in dfn.fillna(7.0).collect()] == [7.0, 7.0, 1.0]
+
+    def build_scalar(s):
+        return s.create_dataframe(data, num_partitions=2).fillna(0)
+
+    def build_dict(s):
+        return s.create_dataframe(data, num_partitions=2).fillna(
+            {"s": "?", "f": -1.0})
+
+    assert_tpu_cpu_equal(build_scalar, ignore_order=False)
+    assert_tpu_cpu_equal(build_dict, ignore_order=False)
+
+    s = tpu_session()
+    rows = s.create_dataframe(data, num_partitions=1).fillna(0).collect()
+    # numeric columns filled, string column untouched by a numeric fill
+    assert rows == [(1, "x", 0.0), (0, None, 2.0), (3, "z", 0.0)]
+    rows = s.create_dataframe(data, num_partitions=1).fillna(
+        {"s": "?", "f": -1.0}).collect()
+    assert rows == [(1, "x", -1.0), (None, "?", 2.0), (3, "z", -1.0)]

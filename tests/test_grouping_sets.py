@@ -126,3 +126,26 @@ def test_grouping_sets_rejects_bad_index():
                              "v": (T.INT, [1])}, num_partitions=1)
     with pytest.raises(ValueError):
         df.grouping_sets(["a"], [(5,)])
+
+
+def test_grouping_sets_bare_expression_and_soft_keywords():
+    s = tpu_session()
+    s.register_view("t", s.create_dataframe(DATA, num_partitions=2))
+    # bare expression = one-element set (Spark shorthand)
+    rows = s.sql("SELECT a, sum(v) AS sv FROM t "
+                 "GROUP BY GROUPING SETS (a, ()) "
+                 "ORDER BY a, sv").collect()
+    assert (None, 60.0) in rows  # grand total present
+    # rollup/cube/grouping/sets are NOT reserved words
+    s.register_view("t2", s.create_dataframe(
+        {"rollup": (T.INT, [1, 2]), "sets": (T.INT, [3, 4])},
+        num_partitions=1))
+    rows = s.sql("SELECT rollup, sets FROM t2 ORDER BY rollup").collect()
+    assert rows == [(1, 3), (2, 4)]
+
+
+def test_grouping_sets_pandas_path_rejected():
+    s = tpu_session()
+    df = s.create_dataframe(DATA, num_partitions=1)
+    with pytest.raises(NotImplementedError):
+        df.rollup("a").apply_in_pandas(lambda p: p, [("a", T.STRING)])
