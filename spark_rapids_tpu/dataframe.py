@@ -987,6 +987,29 @@ class GroupedData:
     def count(self) -> DataFrame:
         return self.agg(Column(Alias(count_star(), "count")))
 
+    def pivot(self, col_name: str, values: Optional[List] = None
+              ) -> "PivotedData":
+        """Pivot a column's values into output columns (pyspark
+        GroupedData.pivot).  Rewritten to per-value conditional
+        aggregates — agg_fn(CASE WHEN pivot = v THEN child END) — so the
+        plan is one ordinary hash aggregation.  Without an explicit
+        ``values`` list the distinct values are computed eagerly (as
+        pyspark does), capped at 10000."""
+        if values is None:
+            vals_df = (self.df.select(self.df[col_name].alias("__pv"))
+                       .distinct().limit(10_001))
+            raw = [r[0] for r in vals_df.collect()]
+            if len(raw) > 10_000:
+                raise ValueError(
+                    "pivot column has more than 10000 distinct values; "
+                    "pass an explicit values list")
+            # ascending native sort, NULL first (Spark sort order)
+            nonnull = sorted(v for v in raw if v is not None)
+            values = ([None] if any(v is None for v in raw) else []) \
+                + nonnull
+        return PivotedData(self.df, self.keys, self.names, col_name,
+                           list(values))
+
     def _simple(self, cls, cols) -> DataFrame:
         targets = cols or [f.name for f in self.df.schema.fields
                            if f.dtype.is_numeric]
@@ -1161,6 +1184,19 @@ class GroupingSetsData(GroupedData):
                                          GROUPING_SET_COL)])
 
 
+def _agg_label(e: Expression) -> str:
+    """pyspark-style pivot column label for an unaliased aggregate:
+    'sum(x)' — falls back to the expression repr for computed args."""
+    child = e.children[0] if e.children else None
+    if isinstance(child, ColumnRef):
+        arg = child.column
+    elif isinstance(child, Literal):
+        arg = str(child.value)
+    else:
+        arg = repr(child) if child is not None else ""
+    return f"{e.name.lower()}({arg})"
+
+
 def _fill_compatible(dtype: T.DataType, value) -> bool:
     """pyspark fill rules: numeric fills numeric, string fills string,
     bool fills bool; mismatches leave the column untouched."""
@@ -1171,3 +1207,64 @@ def _fill_compatible(dtype: T.DataType, value) -> bool:
     if isinstance(value, str):
         return dtype.is_string
     return False
+
+
+class PivotedData(GroupedData):
+    """GroupedData after .pivot(): agg() plans Spark's two-phase pivot —
+    an inner aggregation grouped by (keys, pivot column), then an outer
+    aggregation picking each pivot value's result with
+    first(CASE WHEN pivot = v THEN agg END, ignoreNulls=true) (the
+    ResolvePivot/PivotFirst shape).  Group/value combinations with no
+    rows come out NULL — including for count(), matching pyspark."""
+
+    def __init__(self, df: DataFrame, keys: List[Expression],
+                 names: List[str], pivot_col: str, values: List):
+        super().__init__(df, keys, names)
+        self.pivot_col = pivot_col
+        self.values = values
+
+    def agg(self, *aggs) -> DataFrame:
+        from spark_rapids_tpu import functions as F
+        from spark_rapids_tpu.exprs.aggregates import First
+        from spark_rapids_tpu.exprs.nullexprs import IsNull
+
+        norm = []  # (fn expr, display name or None)
+        for a in aggs:
+            if not isinstance(a, Column):
+                raise TypeError(f"not an aggregate: {a!r}")
+            e, name = a.expr, None
+            if isinstance(e, Alias):
+                name, e = e.alias_name, e.children[0]
+            if not isinstance(e, AggregateFunction):
+                raise TypeError(f"not an aggregate: {a!r}")
+            norm.append((e, name))
+
+        pv_name = "__pivot_val"
+        inner_aggs = [Column(Alias(e, f"__pv_a{j}"))
+                      for j, (e, _) in enumerate(norm)]
+        inner = GroupedData(
+            self.df,
+            self.keys + [resolve(ColumnRef(self.pivot_col),
+                                 self.df.schema)],
+            self.names + [pv_name]).agg(*inner_aggs)
+
+        pcol = inner[pv_name]
+        outer = []
+        for v in self.values:
+            cond = Column(IsNull(pcol.expr)) if v is None else (pcol == v)
+            vlabel = "null" if v is None else str(v)
+            for j, (e, name) in enumerate(norm):
+                picked = First(
+                    F.when(cond, inner[f"__pv_a{j}"]).otherwise(None)
+                    .expr, ignore_nulls=True)
+                # pyspark naming: the bare value for a single aggregate,
+                # '{value}_{alias-or-fn(arg)}' otherwise
+                if len(norm) == 1:
+                    out_name = vlabel
+                else:
+                    label = name or _agg_label(e)
+                    out_name = f"{vlabel}_{label}"
+                outer.append(Column(Alias(picked, out_name)))
+        return (GroupedData(inner, [
+            inner._resolve(ColumnRef(n)) for n in self.names],
+            list(self.names)).agg(*outer))

@@ -1,0 +1,112 @@
+"""GroupedData.pivot: per-value conditional-aggregate rewrite."""
+
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu import types as T
+
+from compare import assert_tpu_cpu_equal, tpu_session
+
+DATA = {"year": (T.INT, [2023, 2023, 2023, 2024, 2024, 2024]),
+        "course": (T.STRING, ["java", "scala", "java", "scala", None,
+                              "java"]),
+        "earnings": (T.DOUBLE, [100.0, 200.0, 50.0, 300.0, 25.0, None])}
+
+
+def test_pivot_explicit_values():
+    def build(s):
+        df = s.create_dataframe(DATA, num_partitions=2)
+        return (df.group_by("year")
+                .pivot("course", ["java", "scala"])
+                .agg(F.sum("earnings").alias("sum"))
+                .order_by("year"))
+
+    assert_tpu_cpu_equal(build, approx=True, ignore_order=False)
+    s = tpu_session()
+    df = s.create_dataframe(DATA, num_partitions=2)
+    rows = (df.group_by("year").pivot("course", ["java", "scala"])
+            .agg(F.sum("earnings").alias("s")).order_by("year").collect())
+    assert rows == [(2023, 150.0, 200.0), (2024, None, 300.0)]
+
+
+def test_pivot_discovers_values_and_null_column():
+    s = tpu_session()
+    df = s.create_dataframe(DATA, num_partitions=2)
+    out = (df.group_by("year").pivot("course")
+           .agg(F.sum("earnings").alias("s")).order_by("year"))
+    # discovered values sort ascending with NULL first (Spark order)
+    assert out.columns == ["year", "null", "java", "scala"]
+    rows = out.collect()
+    assert rows == [(2023, None, 150.0, 200.0),
+                    (2024, 25.0, None, 300.0)]
+
+
+def test_pivot_multiple_aggs_and_count():
+    def build(s):
+        df = s.create_dataframe(DATA, num_partitions=3)
+        return (df.group_by("year")
+                .pivot("course", ["java", "scala"])
+                .agg(F.sum("earnings").alias("sum"),
+                     F.count("earnings").alias("cnt"))
+                .order_by("year"))
+
+    assert_tpu_cpu_equal(build, approx=True, ignore_order=False)
+    s = tpu_session()
+    df = s.create_dataframe(DATA, num_partitions=3)
+    out = (df.group_by("year").pivot("course", ["java"])
+           .agg(F.sum("earnings").alias("sum"),
+                F.count("earnings").alias("cnt")))
+    assert out.columns == ["year", "java_sum", "java_cnt"]
+
+
+def test_pivot_count_star():
+    def build(s):
+        df = s.create_dataframe(DATA, num_partitions=2)
+        return (df.group_by("year")
+                .pivot("course", ["java", "scala"])
+                .agg(F.count("*").alias("n"))
+                .order_by("year"))
+
+    assert_tpu_cpu_equal(build, ignore_order=False)
+    s = tpu_session()
+    df = s.create_dataframe(DATA, num_partitions=2)
+    rows = (df.group_by("year").pivot("course", ["java", "scala"])
+            .agg(F.count("*").alias("n")).order_by("year").collect())
+    # count(*) counts MATCHING rows incl. the null-earnings java row
+    assert rows == [(2023, 2, 1), (2024, 1, 1)]
+
+
+def test_pivot_absent_combo_count_is_null_and_first_picks():
+    """pyspark parity: count() of an absent (group, value) combination is
+    NULL (not 0), and first() under pivot ignores the gating nulls."""
+    s = tpu_session()
+    df = s.create_dataframe(
+        {"k": (T.INT, [1, 1, 1, 2]),
+         "p": (T.STRING, ["a", "b", "a", "a"]),
+         "x": (T.INT, [10, 20, 5, 7])}, num_partitions=2)
+    rows = (df.group_by("k").pivot("p", ["a", "b"])
+            .agg(F.count("x").alias("n")).order_by("k").collect())
+    assert rows == [(1, 2, 1), (2, 1, None)]
+    rows = (df.group_by("k").pivot("p", ["a", "b"])
+            .agg(F.first("x").alias("f")).order_by("k").collect())
+    assert rows[0][2] == 20  # k=1's 'b' cell, not clobbered by gating
+
+    def build(s2):
+        d = s2.create_dataframe(
+            {"k": (T.INT, [1, 1, 1, 2]),
+             "p": (T.STRING, ["a", "b", "a", "a"]),
+             "x": (T.INT, [10, 20, 5, 7])}, num_partitions=2)
+        return (d.group_by("k").pivot("p", ["a", "b"])
+                .agg(F.count("x").alias("n")).order_by("k"))
+
+    assert_tpu_cpu_equal(build, ignore_order=False)
+
+
+def test_pivot_unaliased_multi_agg_names_disambiguate():
+    s = tpu_session()
+    df = s.create_dataframe(
+        {"k": (T.INT, [1]), "p": (T.STRING, ["a"]),
+         "x": (T.INT, [1]), "y": (T.INT, [2])}, num_partitions=1)
+    out = (df.group_by("k").pivot("p", ["a"])
+           .agg(F.sum("x"), F.sum("y")))
+    assert out.columns == ["k", "a_sum(x)", "a_sum(y)"]
