@@ -516,6 +516,43 @@ class DataFrame:
 
     exceptDistinct = subtract
 
+    def describe(self, *cols) -> "DataFrame":
+        """count/mean/stddev/min/max summary of numeric columns (pyspark
+        DataFrame.describe): a small string-typed frame with a 'summary'
+        column.  Computed eagerly (one aggregation pass)."""
+        from spark_rapids_tpu import functions as F
+        targets = list(cols) or [f.name for f in self.schema.fields
+                                 if f.dtype.is_numeric or
+                                 f.dtype.is_string]
+        data = {"summary": (T.STRING,
+                            ["count", "mean", "stddev", "min", "max"])}
+        if targets:
+            aggs = []
+            for c in targets:
+                numeric = self.schema.field(c).dtype.is_numeric
+                aggs.append(F.count(c).alias(f"c_{c}"))
+                if numeric:
+                    aggs += [F.avg(c).alias(f"m_{c}"),
+                             F.stddev(c).alias(f"s_{c}")]
+                aggs += [F.min(c).alias(f"mn_{c}"),
+                         F.max(c).alias(f"mx_{c}")]
+            row = list(self.agg(*aggs).collect()[0])
+
+            def s(v):
+                return None if v is None else str(v)
+
+            i = 0
+            for c in targets:
+                numeric = self.schema.field(c).dtype.is_numeric
+                cnt = row[i]; i += 1
+                mean = std = None
+                if numeric:
+                    mean, std = row[i], row[i + 1]; i += 2
+                mn, mx = row[i], row[i + 1]; i += 2
+                data[c] = (T.STRING,
+                           [str(cnt), s(mean), s(std), s(mn), s(mx)])
+        return self.session.create_dataframe(data, num_partitions=1)
+
     def fillna(self, value, subset: Optional[List[str]] = None
                ) -> "DataFrame":
         """Replace nulls — and NaNs in float columns — with ``value``
@@ -793,24 +830,29 @@ class GroupedData:
         self.keys = keys
         self.names = names
 
+    @staticmethod
+    def _unwrap_agg(a) -> Tuple[AggregateFunction, Optional[str]]:
+        """(aggregate fn, alias-or-None) from an agg() argument."""
+        if isinstance(a, AggregateExpression):
+            return a.fn, a.output_name
+        if isinstance(a, Column):
+            e, name = a.expr, None
+            if isinstance(e, Alias):
+                name, e = e.alias_name, e.children[0]
+            if isinstance(e, AggregateFunction):
+                return e, name
+        raise TypeError(f"not an aggregate: {a!r}")
+
     def agg(self, *aggs) -> DataFrame:
         out: List[AggregateExpression] = []
         for i, a in enumerate(aggs):
             if isinstance(a, AggregateExpression):
                 out.append(a)
-            elif isinstance(a, Column):
-                e = a.expr
-                name = None
-                if isinstance(e, Alias):
-                    name = e.alias_name
-                    e = e.children[0]
-                if not isinstance(e, AggregateFunction):
-                    raise TypeError(f"not an aggregate: {a!r}")
-                e = _resolve_agg(e, self.df.schema)
-                out.append(AggregateExpression(
-                    e, name or f"{e.name.lower()}_{i}"))
-            else:
-                raise TypeError(f"not an aggregate: {a!r}")
+                continue
+            e, name = self._unwrap_agg(a)
+            e = _resolve_agg(e, self.df.schema)
+            out.append(AggregateExpression(
+                e, name or f"{e.name.lower()}_{i}"))
         from spark_rapids_tpu.exprs import aggregates as A
         if any(isinstance(a.fn, A.Percentile) for a in out):
             return self._agg_with_percentile(out)
@@ -1228,16 +1270,7 @@ class PivotedData(GroupedData):
         from spark_rapids_tpu.exprs.aggregates import First
         from spark_rapids_tpu.exprs.nullexprs import IsNull
 
-        norm = []  # (fn expr, display name or None)
-        for a in aggs:
-            if not isinstance(a, Column):
-                raise TypeError(f"not an aggregate: {a!r}")
-            e, name = a.expr, None
-            if isinstance(e, Alias):
-                name, e = e.alias_name, e.children[0]
-            if not isinstance(e, AggregateFunction):
-                raise TypeError(f"not an aggregate: {a!r}")
-            norm.append((e, name))
+        norm = [self._unwrap_agg(a) for a in aggs]
 
         pv_name = "__pivot_val"
         inner_aggs = [Column(Alias(e, f"__pv_a{j}"))

@@ -449,3 +449,103 @@ class GroupingID(AggregateFunction):
     def buffers(self):
         raise AssertionError(
             "grouping_id() is only valid under rollup/cube/grouping sets")
+
+
+class _CentralMoment(AggregateFunction):
+    """stddev/variance family over (n, n*mean, m2-contribution) buffers.
+
+    Partials merge with Chan's k-way formula expressed as three segment
+    sums: S0 = Σnᵢ, S1 = Σnᵢ·meanᵢ, S2 = Σ(m2ᵢ + nᵢ·meanᵢ²); then
+    mean = S1/S0 and m2 = S2 − S1²/S0 — numerically safer than raw
+    sum-of-squares across shuffled partials.  Spark semantics: NULL for
+    zero rows; sample variants give NaN for a single row (0/0)."""
+
+    _sample = True   # ddof=1
+    _sqrt = False    # stddev vs variance
+
+    def _resolve_type(self):
+        self.dtype = T.DOUBLE
+        self.nullable = True
+
+    def buffers(self):
+        return [AggBufferSpec(T.DOUBLE), AggBufferSpec(T.DOUBLE),
+                AggBufferSpec(T.DOUBLE)]
+
+    def segment_update(self, v, seg_ids, num_segments, live_mask):
+        use = v.validity & live_mask
+        x = jnp.where(use, v.data.astype(jnp.float64), 0.0)
+        n = jax.ops.segment_sum(use.astype(jnp.float64), seg_ids,
+                                num_segments=num_segments,
+                                indices_are_sorted=True)
+        s1 = jax.ops.segment_sum(x, seg_ids, num_segments=num_segments,
+                                 indices_are_sorted=True)
+        # two-pass m2: deviations from the per-group mean, NOT the
+        # cancellation-prone Σx² − (Σx)²/n (large-mean data — e.g. epoch
+        # timestamps — loses every significant digit under that form)
+        mean = s1 / jnp.maximum(n, 1.0)
+        d = jnp.where(use, x - mean[seg_ids], 0.0)
+        m2 = jax.ops.segment_sum(d * d, seg_ids,
+                                 num_segments=num_segments,
+                                 indices_are_sorted=True)
+        ones = jnp.ones(num_segments, dtype=jnp.bool_)
+        return [DevVal(T.DOUBLE, n, ones),
+                DevVal(T.DOUBLE, s1, ones),   # n*mean = Σx
+                DevVal(T.DOUBLE, m2, ones)]
+
+    def segment_merge(self, buffers, seg_ids, num_segments, live_mask):
+        n_i, nm_i, m2_i = (b.data for b in buffers)
+        live = live_mask.astype(jnp.float64)
+        s0 = jax.ops.segment_sum(n_i * live, seg_ids,
+                                 num_segments=num_segments,
+                                 indices_are_sorted=True)
+        s1 = jax.ops.segment_sum(nm_i * live, seg_ids,
+                                 num_segments=num_segments,
+                                 indices_are_sorted=True)
+        # deviation form of Chan's combine: m2 = Σm2ᵢ + Σnᵢ·(meanᵢ−mean)²
+        # — the Σnᵢ·meanᵢ² − n·mean² form cancels catastrophically for
+        # large means (epoch-scale data), this one never does
+        mean = s1 / jnp.maximum(s0, 1.0)
+        mean_i = nm_i / jnp.maximum(n_i, 1.0)
+        dev = mean_i - mean[seg_ids]
+        m2 = jax.ops.segment_sum((m2_i + n_i * dev * dev) * live, seg_ids,
+                                 num_segments=num_segments,
+                                 indices_are_sorted=True)
+        ones = jnp.ones(num_segments, dtype=jnp.bool_)
+        return [DevVal(T.DOUBLE, s0, ones), DevVal(T.DOUBLE, s1, ones),
+                DevVal(T.DOUBLE, m2, ones)]
+
+    def finalize(self, buffers):
+        n, _, m2 = (b.data for b in buffers)
+        m2 = jnp.maximum(m2, 0.0)  # clamp negative rounding residue
+        denom = n - 1.0 if self._sample else n
+        out = m2 / denom  # n==1 sample: 0/0 -> NaN (Spark)
+        if self._sqrt:
+            out = jnp.sqrt(out)
+        return DevVal(T.DOUBLE, out, n > 0)
+
+    def cpu_reduce(self, values, validity):
+        vals = np.asarray(values[validity], dtype=np.float64)
+        if len(vals) == 0:
+            return None
+        ddof = 1 if self._sample else 0
+        if self._sample and len(vals) == 1:
+            return float("nan")
+        with np.errstate(all="ignore"):
+            var = float(np.var(vals, ddof=ddof))
+            return float(np.sqrt(var)) if self._sqrt else var
+
+
+class StddevSamp(_CentralMoment):
+    _sample, _sqrt = True, True
+
+
+class StddevPop(_CentralMoment):
+    _sample, _sqrt = False, True
+
+
+class VarianceSamp(_CentralMoment):
+    _sample, _sqrt = True, False
+
+
+class VariancePop(_CentralMoment):
+    _sample, _sqrt = False, False

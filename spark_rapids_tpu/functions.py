@@ -64,6 +64,28 @@ def last(c, ignore_nulls: bool = False) -> Column:
     return Column(A.Last(e, ignore_nulls))
 
 
+def stddev(c) -> Column:
+    return _agg(A.StddevSamp, c)
+
+
+stddev_samp = stddev
+
+
+def stddev_pop(c) -> Column:
+    return _agg(A.StddevPop, c)
+
+
+def variance(c) -> Column:
+    return _agg(A.VarianceSamp, c)
+
+
+var_samp = variance
+
+
+def var_pop(c) -> Column:
+    return _agg(A.VariancePop, c)
+
+
 def grouping_id() -> Column:
     """Bitmask of masked-out keys under rollup/cube/grouping sets."""
     from spark_rapids_tpu.exprs.aggregates import GroupingID

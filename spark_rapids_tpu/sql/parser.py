@@ -615,6 +615,10 @@ def _build_function(name: str, args: List[Expression], star: bool,
     simple = {
         "sum": A.Sum, "avg": A.Average, "mean": A.Average, "min": A.Min,
         "max": A.Max, "first": A.First, "last": A.Last,
+        "stddev": A.StddevSamp, "stddev_samp": A.StddevSamp,
+        "std": A.StddevSamp, "stddev_pop": A.StddevPop,
+        "variance": A.VarianceSamp, "var_samp": A.VarianceSamp,
+        "var_pop": A.VariancePop,
         "abs": None, "sqrt": M.Sqrt, "exp": M.Exp, "ln": M.Log,
         "log": M.Log, "log2": M.Log2, "log10": M.Log10, "floor": M.Floor,
         "ceil": M.Ceil, "ceiling": M.Ceil, "sin": M.Sin, "cos": M.Cos,
