@@ -1,0 +1,192 @@
+"""Deterministic expression fuzzer: random typed expression trees
+evaluated on the TPU engine and the CPU oracle must agree (the
+random-data + random-shape layer of the reference's integration tests,
+cf. integration_tests data_gen.py's randomized generators — here the
+SHAPES are randomized too)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu import types as T
+
+from compare import assert_tpu_cpu_equal
+
+ROWS = 160
+
+
+def _base_data(seed):
+    r = np.random.RandomState(seed)
+
+    def with_nulls(vals, frac=0.15):
+        out = list(vals)
+        for i in range(len(out)):
+            if r.rand() < frac:
+                out[i] = None
+        return out
+
+    strings = ["", "a", "bb", "spark", "TPU engine", "x-y-z",
+               "  pad  ", "zz top", "NULLish", "0123456789"]
+    return {
+        "rid": (T.LONG, list(range(ROWS))),  # unique, never null
+        "i": (T.INT, with_nulls(r.randint(-1000, 1000, ROWS))),
+        "j": (T.INT, with_nulls(r.randint(-5, 6, ROWS))),
+        "l": (T.LONG, with_nulls(r.randint(-10**9, 10**9, ROWS))),
+        "d": (T.DOUBLE, with_nulls((r.rand(ROWS) * 2000 - 1000)
+                                   .round(4))),
+        "e": (T.DOUBLE, with_nulls((r.rand(ROWS) * 4 - 2).round(6))),
+        "b": (T.BOOLEAN, with_nulls(r.rand(ROWS) < 0.5)),
+        "s": (T.STRING, with_nulls([strings[k] for k in
+                                    r.randint(0, len(strings), ROWS)])),
+        "dt": (T.DATE, with_nulls(r.randint(0, 20000, ROWS))),
+    }
+
+
+class _Gen:
+    """Typed random expression-tree builder."""
+
+    # "l" (1e9-scale) excluded: under the chip's f64 emulation,
+    # symmetric trees can cancel 1e9-scale intermediates to ~0 where a
+    # 3.5e-15 relative emulation difference exceeds the comparison's
+    # absolute tolerance.  Bounded leaves keep full-cancellation error
+    # below it.
+    NUM_COLS = ["i", "j", "d", "e"]
+    SMALL_COLS = ["j", "e"]
+
+    def __init__(self, rng, df):
+        self.r = rng
+        self.df = df
+
+    def pick(self, options):
+        return options[self.r.randint(0, len(options))]
+
+    def numeric(self, depth):
+        if depth <= 0:
+            if self.r.rand() < 0.25:
+                return F.lit(float(self.r.randint(-50, 51)))
+            return self.df[self.pick(self.NUM_COLS)]
+        a = self.numeric(depth - 1)
+        b = self.numeric(depth - 1)
+        kind = self.r.randint(0, 10)
+        if kind == 0:
+            return a + b
+        if kind == 1:
+            return a - b
+        if kind == 2:
+            # products only over small leaves: bounds the value range so
+            # later cancellation stays within comparison tolerance
+            sa = self.df[self.pick(self.SMALL_COLS)]
+            sb = self.df[self.pick(self.SMALL_COLS)]
+            return sa * sb
+        if kind == 3:
+            if self.r.rand() < 0.3:
+                # leaf/j exercises /0 -> NULL with a bounded quotient
+                # (|i/j| <= 1000; j is small-integer and contains 0)
+                return self.df[self.pick(self.NUM_COLS)] / self.df["j"]
+            # bounded-denominator variant: |quotient| <= |a|, so later
+            # subtractions cannot cancel emulation-scale residue
+            sb = self.df[self.pick(self.SMALL_COLS)]
+            return a / (F.abs(sb) + F.lit(1.0))
+        if kind == 4:
+            return F.abs(a)
+        if kind == 5:
+            return F.coalesce(a, b)
+        if kind == 6:
+            return F.when(self.boolean(depth - 1), a).otherwise(b)
+        if kind == 7:
+            return F.floor(a)
+        if kind == 8:
+            return F.length(self.string(depth - 1)).cast(T.DOUBLE)
+        return -a
+
+    def boolean(self, depth):
+        if depth <= 0:
+            return self.df["b"]
+        kind = self.r.randint(0, 7)
+        if kind == 0:
+            return self.numeric(depth - 1) < self.numeric(depth - 1)
+        if kind == 1:
+            return self.numeric(depth - 1) >= self.numeric(depth - 1)
+        if kind == 2:
+            return self.boolean(depth - 1) & self.boolean(depth - 1)
+        if kind == 3:
+            return self.boolean(depth - 1) | self.boolean(depth - 1)
+        if kind == 4:
+            return ~self.boolean(depth - 1)
+        if kind == 5:
+            return self.string(depth - 1).is_null()
+        return self.numeric(depth - 1) == self.numeric(depth - 1)
+
+    def string(self, depth):
+        if depth <= 0:
+            return self.df["s"]
+        kind = self.r.randint(0, 6)
+        if kind == 0:
+            return F.upper(self.string(depth - 1))
+        if kind == 1:
+            return F.lower(self.string(depth - 1))
+        if kind == 2:
+            return F.substring(self.string(depth - 1),
+                               int(self.r.randint(1, 4)),
+                               int(self.r.randint(1, 6)))
+        if kind == 3:
+            return F.concat(self.string(depth - 1),
+                            self.string(depth - 1))
+        if kind == 4:
+            return F.trim(self.string(depth - 1))
+        return F.when(self.boolean(depth - 1),
+                      self.string(depth - 1)).otherwise(
+            self.string(depth - 1))
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_fuzz_projection_trees(seed):
+    """12 random projections per seed, depth <= 3, both engines agree."""
+    def build(s):
+        df = s.create_dataframe(_base_data(seed), num_partitions=3)
+        g = _Gen(np.random.RandomState(1000 + seed), df)
+        cols = []
+        for k in range(6):
+            cols.append(g.numeric(3).alias(f"n{k}"))
+        for k in range(3):
+            cols.append(g.boolean(2).alias(f"b{k}"))
+        for k in range(3):
+            cols.append(g.string(2).alias(f"s{k}"))
+        return df.select(*cols)
+
+    assert_tpu_cpu_equal(build, approx=True, ignore_order=False)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_filter_agg(seed):
+    """Random filter + grouped aggregation pipelines agree."""
+    def build(s):
+        df = s.create_dataframe(_base_data(100 + seed),
+                                num_partitions=3)
+        g = _Gen(np.random.RandomState(2000 + seed), df)
+        filtered = df.filter(g.boolean(2))
+        return (filtered.group_by("j")
+                .agg(F.sum(g.numeric(2)).alias("sx"),
+                     F.count("*").alias("n"),
+                     F.max(g.numeric(1)).alias("mx"))
+                .order_by("j"))
+
+    assert_tpu_cpu_equal(build, approx=True, ignore_order=False)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_sort_keys(seed):
+    """Random multi-key sorts (mixed types/directions) agree."""
+    def build(s):
+        df = s.create_dataframe(_base_data(200 + seed),
+                                num_partitions=3)
+        r = np.random.RandomState(3000 + seed)
+        keys = []
+        for name in ["i", "s", "d", "b", "dt"]:
+            if r.rand() < 0.6:
+                c = df[name]
+                keys.append(c.asc() if r.rand() < 0.5 else c.desc())
+        keys.append(df["rid"].asc())  # unique non-null tiebreaker
+        return df.order_by(*keys)
+
+    assert_tpu_cpu_equal(build, approx=True, ignore_order=False)
