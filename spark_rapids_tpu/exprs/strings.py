@@ -1280,3 +1280,75 @@ class StringSplit(Expression):
         for i, s in enumerate(v.values):
             out[i] = pat.split(str(s)) if pat else [str(s)]
         return CpuVal(self.dtype, out, v.validity)
+
+
+class Hex(Expression):
+    """hex(integral) -> uppercase hex string (Spark Hex / GpuOverrides'
+    hex; negative longs render as 16-digit two's complement).  Device
+    path computes nibbles with arithmetic shifts — no 64-bit bitcast,
+    which the chip's f64/i64 emulation cannot do."""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+        self.dtype = T.STRING
+        self.nullable = child.nullable
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def with_children(self, children):
+        return Hex(children[0])
+
+    def tpu_supported(self, conf):
+        if self.child.dtype is not T.NULL and \
+                not self.child.dtype.is_integral:
+            return "hex over non-integral inputs runs on CPU"
+        return None
+
+    def tpu_eval(self, ctx) -> DevVal:
+        v = self.child.tpu_eval(ctx)
+        cap = v.capacity
+        x = v.data.astype(jnp.int64)
+        # nibble k (0 = most significant); arithmetic >> keeps two's
+        # complement bits, & 15 extracts the nibble
+        nibbles = jnp.stack(
+            [(x >> (4 * (15 - k))) & 15 for k in range(16)],
+            axis=1).astype(jnp.int32)                       # [cap, 16]
+        digits = jnp.where(nibbles < 10, nibbles + 48,
+                           nibbles + 55).astype(jnp.uint8)
+        # length = 16 - leading zero nibbles (min 1 so 0 -> "0")
+        nz = nibbles != 0
+        first_nz = jnp.argmax(nz, axis=1)                   # 0 if none
+        any_nz = jnp.any(nz, axis=1)
+        lens = jnp.where(any_nz, 16 - first_nz, 1).astype(jnp.int32)
+        live = v.validity & ctx.row_mask
+        lens = jnp.where(live, lens, 0)
+        flat = digits.reshape(-1)
+        offsets16 = (jnp.arange(cap + 1, dtype=jnp.int32) * 16)
+        v16 = DevVal(T.STRING, flat, v.validity, offsets16)
+        rel_start = jnp.where(any_nz, first_nz, 15).astype(jnp.int32)
+        # cap is a power-of-two bucket, so cap*16 is too (stable compile
+        # cache keys)
+        return _gather_substring(v16, rel_start, lens, cap * 16,
+                                 v.validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        v = self.child.cpu_eval(ctx)
+        out = np.empty(len(v.values), dtype=object)
+        is_str = self.child.dtype.is_string
+        for i, x in enumerate(v.values):
+            if is_str:
+                # Spark hex(string) = hex of the UTF-8 bytes
+                out[i] = str(x).encode("utf-8").hex().upper()
+                continue
+            if self.child.dtype.is_fractional:
+                # Spark's implicit double->bigint cast: truncate toward
+                # zero, NaN -> 0
+                xf = float(x)
+                xi = 0 if xf != xf else int(xf)
+            else:
+                xi = int(x)  # int64-exact: no float round trip
+            out[i] = format(xi if xi >= 0 else xi + (1 << 64), "X")
+        return CpuVal(T.STRING, out, v.validity)
+

@@ -242,6 +242,11 @@ def split(c, delimiter: str) -> Column:
     return Column(StringSplit(_to_expr(c), delimiter))
 
 
+def hex(c) -> Column:  # noqa: A001
+    from spark_rapids_tpu.exprs.strings import Hex
+    return _unary(Hex, c)
+
+
 def upper(c) -> Column:
     from spark_rapids_tpu.exprs.strings import Upper
     return _unary(Upper, c)

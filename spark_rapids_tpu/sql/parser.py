@@ -627,7 +627,7 @@ def _build_function(name: str, args: List[Expression], star: bool,
         "cosh": M.Cosh, "tanh": M.Tanh, "asinh": M.Asinh,
         "acosh": M.Acosh, "atanh": M.Atanh, "cot": M.Cot,
         "upper": S.Upper, "ucase": S.Upper, "lower": S.Lower,
-        "initcap": S.InitCap,
+        "initcap": S.InitCap, "hex": S.Hex,
         "lcase": S.Lower, "length": S.Length, "char_length": S.Length,
         "trim": S.StringTrim, "ltrim": S.StringTrimLeft,
         "rtrim": S.StringTrimRight,

@@ -186,3 +186,43 @@ def test_fillna():
     rows = s.create_dataframe(data, num_partitions=1).fillna(
         {"s": "?", "f": -1.0}).collect()
     assert rows == [(1, "x", -1.0), (None, "?", 2.0), (3, "z", -1.0)]
+
+
+def test_hex():
+    data = {"l": (T.LONG, [0, 1, 255, 4095, -1, -255,
+                           9223372036854775807, None]),
+            "i": (T.INT, [16, -16, 0, None, 1, 2, 3, 4])}
+
+    def build(s):
+        s.register_view("t", s.create_dataframe(data, num_partitions=2))
+        return s.sql("SELECT hex(l) AS hl, hex(i) AS hi FROM t")
+
+    assert_tpu_cpu_equal(build, ignore_order=False)
+    s = tpu_session()
+    df = s.create_dataframe(data, num_partitions=1)
+    rows = [r[0] for r in df.select(F.hex("l").alias("h")).collect()]
+    assert rows[0] == "0" and rows[1] == "1" and rows[2] == "FF"
+    assert rows[3] == "FFF"
+    assert rows[4] == "FFFFFFFFFFFFFFFF"      # -1 two's complement
+    assert rows[5] == "FFFFFFFFFFFFFF01"      # -255
+    assert rows[6] == "7FFFFFFFFFFFFFFF"
+    assert rows[7] is None
+
+
+def test_hex_string_and_double_fallback():
+    data = {"s": (T.STRING, ["Spark SQL", "", None]),
+            "f": (T.DOUBLE, [1.5, -2.9, float("nan")])}
+
+    def build(s):
+        s.register_view("t", s.create_dataframe(data, num_partitions=2))
+        return s.sql("SELECT hex(s) AS hs, hex(f) AS hf FROM t")
+
+    assert_tpu_cpu_equal(build, ignore_order=False,
+                         expect_fallback="hex")
+    s = tpu_session()
+    df = s.create_dataframe(data, num_partitions=1)
+    rows = df.select(F.hex("s").alias("hs"),
+                     F.hex("f").alias("hf")).collect()
+    assert rows[0] == ("537061726B2053514C", "1")
+    assert rows[1] == ("", "FFFFFFFFFFFFFFFE")  # trunc toward zero: -2
+    assert rows[2][0] is None and rows[2][1] == "0"  # NaN -> 0
