@@ -854,12 +854,100 @@ class GroupedData:
             out.append(AggregateExpression(
                 e, name or f"{e.name.lower()}_{i}"))
         from spark_rapids_tpu.exprs import aggregates as A
+        if any(isinstance(a.fn, A._BinaryStatMarker) for a in out):
+            return self._agg_with_binary_stats(out)
         if any(isinstance(a.fn, A.Percentile) for a in out):
             return self._agg_with_percentile(out)
         if any(isinstance(a.fn, A.CountDistinct) for a in out):
             return self._agg_with_distinct(out)
         node = L.Aggregate(self.keys, self.names, out, self.df.plan)
         return DataFrame(node, self.df.session)
+
+    def _agg_with_binary_stats(self, out: List[AggregateExpression]
+                               ) -> DataFrame:
+        """corr / covar_pop / covar_samp rewrite: no aggregation path
+        takes two inputs, so each marker becomes window means over the
+        pair-complete rows + a SUM of centered products, with the ratio
+        computed in a post-projection (mean-shifted => no large-mean
+        cancellation):
+
+            gx  = x when both non-null; gy likewise
+            mx  = avg(gx) OVER (keys); my = avg(gy) OVER (keys)
+            sp  = SUM((gx-mx)*(gy-my)); n = COUNT(gx)
+            covar_pop  = sp/n;  covar_samp = sp/(n-1) (NaN at n=1)
+            corr       = sp / sqrt(SUM((gx-mx)^2) * SUM((gy-my)^2))
+                         (NaN when a variance is 0); NULL for n=0.
+        """
+        from spark_rapids_tpu import functions as F
+        from spark_rapids_tpu.exprs import aggregates as A
+
+        df = self.df
+        key_cols = [Column(k) for k in self.keys]
+        wp = F.Window.partition_by(*key_cols)
+        final: List = []
+        post = {}  # output name -> builder(frame) -> Column
+        for i, a in enumerate(out):
+            fn = a.fn
+            if not isinstance(fn, A._BinaryStatMarker):
+                final.append(a)
+                continue
+            x, y = Column(fn.left), Column(fn.right)
+            both = x.is_not_null() & y.is_not_null()
+            gxn, gyn = f"__bs_x{i}", f"__bs_y{i}"
+            mxn, myn = f"__bs_mx{i}", f"__bs_my{i}"
+            df = (df.with_column(gxn, F.when(both, x.cast(T.DOUBLE))
+                                 .otherwise(None))
+                  .with_column(gyn, F.when(both, y.cast(T.DOUBLE))
+                               .otherwise(None)))
+            df = (df.with_column(mxn, F.avg(df[gxn]).over(wp))
+                  .with_column(myn, F.avg(df[gyn]).over(wp)))
+            dx = df[gxn] - df[mxn]
+            dy = df[gyn] - df[myn]
+            spn, nn = f"__bs_sp{i}", f"__bs_n{i}"
+            final.append(AggregateExpression(
+                _resolve_agg(A.Sum((dx * dy).expr), df.schema), spn))
+            final.append(AggregateExpression(
+                _resolve_agg(A.Count(ColumnRef(gxn)), df.schema), nn))
+            if isinstance(fn, A.Corr):
+                sxn, syn = f"__bs_sx{i}", f"__bs_sy{i}"
+                final.append(AggregateExpression(
+                    _resolve_agg(A.Sum((dx * dx).expr), df.schema), sxn))
+                final.append(AggregateExpression(
+                    _resolve_agg(A.Sum((dy * dy).expr), df.schema), syn))
+
+                def mk_corr(g, spn=spn, nn=nn, sxn=sxn, syn=syn):
+                    denom = g[sxn] * g[syn]
+                    nan = F.lit(float("nan"))
+                    return F.when(
+                        (g[nn] >= 1) & (denom > 0),
+                        g[spn] / F.sqrt(denom)).when(
+                        g[nn] >= 1, nan).otherwise(None)
+                post[a.output_name] = mk_corr
+            elif isinstance(fn, A.CovarSamp):
+                def mk_cs(g, spn=spn, nn=nn):
+                    nan = F.lit(float("nan"))
+                    samp = g[spn] / (g[nn] - 1).cast(T.DOUBLE)
+                    return (F.when(g[nn] > 1, samp)
+                            .when(g[nn] == 1, nan).otherwise(None))
+                post[a.output_name] = mk_cs
+            else:
+                def mk_cp(g, spn=spn, nn=nn):
+                    return (F.when(g[nn] >= 1,
+                                   g[spn] / g[nn].cast(T.DOUBLE))
+                            .otherwise(None))
+                post[a.output_name] = mk_cp
+        gd = GroupedData(df, self.keys, self.names)
+        grouped = gd.agg(*final)
+        sel = []
+        for name in self.names:
+            sel.append(grouped[name].alias(name))
+        for a in out:
+            if a.output_name in post:
+                sel.append(post[a.output_name](grouped)
+                           .alias(a.output_name))
+            else:
+                sel.append(grouped[a.output_name].alias(a.output_name))
+        return grouped.select(*sel)
 
     def _agg_with_percentile(self, out: List[AggregateExpression]
                              ) -> DataFrame:
@@ -1126,10 +1214,12 @@ def _to_schema(schema) -> T.Schema:
 
 def _resolve_agg(fn: AggregateFunction, schema: T.Schema
                  ) -> AggregateFunction:
+    if len(fn.children) > 1:  # binary-stat markers (corr/covar)
+        return fn.with_children(
+            [resolve(c, schema) for c in fn.children])
     child = resolve(fn.fn_child if hasattr(fn, "fn_child") else fn.child,
                     schema)
-    new = fn.with_children([child])
-    return new
+    return fn.with_children([child])
 
 
 GROUPING_ID_COL = "__grouping_id"

@@ -549,3 +549,53 @@ class VarianceSamp(_CentralMoment):
 
 class VariancePop(_CentralMoment):
     _sample, _sqrt = False, False
+
+
+class _BinaryStatMarker(AggregateFunction):
+    """corr/covar family marker: two children, never executed directly —
+    the dataframe layer rewrites it onto windows + arithmetic + SUM
+    (GroupedData._agg_with_binary_stats), since every aggregation path
+    assumes single-child aggregates."""
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+        self._resolve_type()
+
+    @property
+    def left(self):
+        return self.children[0]
+
+    @property
+    def right(self):
+        return self.children[1]
+
+    def with_children(self, children):
+        return type(self)(children[0], children[1])
+
+    def _resolve_type(self):
+        for c in self.children:
+            if c.dtype is not T.NULL and not c.dtype.is_numeric:
+                raise TypeError(
+                    f"{type(self).__name__} needs numeric inputs, "
+                    f"got {c.dtype}")
+        self.dtype = T.DOUBLE
+        self.nullable = True
+
+    def tpu_supported(self, conf):
+        return None
+
+    def buffers(self):
+        raise AssertionError(
+            f"{type(self).__name__} must be rewritten before execution")
+
+
+class CovarPop(_BinaryStatMarker):
+    pass
+
+
+class CovarSamp(_BinaryStatMarker):
+    pass
+
+
+class Corr(_BinaryStatMarker):
+    pass

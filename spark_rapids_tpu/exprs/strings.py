@@ -1344,9 +1344,17 @@ class Hex(Expression):
                 continue
             if self.child.dtype.is_fractional:
                 # Spark's implicit double->bigint cast: truncate toward
-                # zero, NaN -> 0
+                # zero, NaN -> 0, +-inf/out-of-range saturate at the
+                # long bounds (same rules as Cast.cpu_eval)
                 xf = float(x)
-                xi = 0 if xf != xf else int(xf)
+                if xf != xf:
+                    xi = 0
+                elif xf >= 2.0 ** 63:
+                    xi = (1 << 63) - 1
+                elif xf < -(2.0 ** 63):
+                    xi = -(1 << 63)
+                else:
+                    xi = int(xf)
             else:
                 xi = int(x)  # int64-exact: no float round trip
             out[i] = format(xi if xi >= 0 else xi + (1 << 64), "X")

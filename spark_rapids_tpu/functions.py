@@ -86,6 +86,24 @@ def var_pop(c) -> Column:
     return _agg(A.VariancePop, c)
 
 
+def _binstat(cls, x, y) -> Column:
+    x = col(x) if isinstance(x, str) else x
+    y = col(y) if isinstance(y, str) else y
+    return Column(cls(_to_expr(x), _to_expr(y)))
+
+
+def corr(x, y) -> Column:
+    return _binstat(A.Corr, x, y)
+
+
+def covar_pop(x, y) -> Column:
+    return _binstat(A.CovarPop, x, y)
+
+
+def covar_samp(x, y) -> Column:
+    return _binstat(A.CovarSamp, x, y)
+
+
 def grouping_id() -> Column:
     """Bitmask of masked-out keys under rollup/cube/grouping sets."""
     from spark_rapids_tpu.exprs.aggregates import GroupingID

@@ -663,6 +663,12 @@ def _build_function(name: str, args: List[Expression], star: bool,
         return S.StringSplit(args[0], args[1])
     if name == "grouping_id":
         return A.GroupingID()
+    if name in ("corr", "covar_pop", "covar_samp"):
+        cls = {"corr": A.Corr, "covar_pop": A.CovarPop,
+               "covar_samp": A.CovarSamp}[name]
+        if len(args) != 2:
+            raise SyntaxError(f"{name}(x, y) takes two arguments")
+        return cls(args[0], args[1])
     if name == "percentile":
         from spark_rapids_tpu.exprs.base import Literal
         if len(args) != 2 or not isinstance(args[1], Literal) \

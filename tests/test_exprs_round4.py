@@ -226,3 +226,14 @@ def test_hex_string_and_double_fallback():
     assert rows[0] == ("537061726B2053514C", "1")
     assert rows[1] == ("", "FFFFFFFFFFFFFFFE")  # trunc toward zero: -2
     assert rows[2][0] is None and rows[2][1] == "0"  # NaN -> 0
+
+
+def test_hex_double_saturation():
+    data = {"f": (T.DOUBLE, [float("inf"), float("-inf"), 1e20, -1e20])}
+    s = tpu_session()
+    df = s.create_dataframe(data, num_partitions=1)
+    rows = [r[0] for r in df.select(F.hex("f").alias("h")).collect()]
+    assert rows[0] == "7FFFFFFFFFFFFFFF"   # +inf -> Long.MAX
+    assert rows[1] == "8000000000000000"   # -inf -> Long.MIN
+    assert rows[2] == "7FFFFFFFFFFFFFFF"   # out of range saturates
+    assert rows[3] == "8000000000000000"

@@ -99,3 +99,64 @@ def test_describe_strings_and_empty():
     rows = dict((r[0], r[1]) for r in df.describe().collect())
     assert rows["count"] == "2" and rows["min"] == "x" \
         and rows["max"] == "y" and rows["mean"] is None
+
+
+BDATA = {"g": (T.STRING, ["a", "a", "a", "a", "b", "b", "c", "d", "d"]),
+         "x": (T.DOUBLE, [1.0, 2.0, 3.0, None, 5.0, 7.0, 4.0, None,
+                          None]),
+         "y": (T.DOUBLE, [2.0, 4.1, 5.9, 9.0, 1.0, None, 8.0, 1.0,
+                          None])}
+
+
+def test_corr_covar_ground_truth():
+    s = tpu_session()
+    df = s.create_dataframe(BDATA, num_partitions=2)
+    rows = (df.group_by("g")
+            .agg(F.corr("x", "y").alias("r"),
+                 F.covar_pop("x", "y").alias("cp"),
+                 F.covar_samp("x", "y").alias("cs"),
+                 F.count("x").alias("n"))
+            .order_by("g").collect())
+    by_g = {r[0]: r[1:] for r in rows}
+    # group a: pair-complete rows are (1,2),(2,4.1),(3,5.9)
+    xs, ys = np.array([1.0, 2.0, 3.0]), np.array([2.0, 4.1, 5.9])
+    assert by_g["a"][0] == pytest.approx(float(np.corrcoef(xs, ys)[0, 1]))
+    assert by_g["a"][1] == pytest.approx(
+        float(np.cov(xs, ys, ddof=0)[0, 1]))
+    assert by_g["a"][2] == pytest.approx(
+        float(np.cov(xs, ys, ddof=1)[0, 1]))
+    # group b: single complete pair -> corr NaN, covar_pop 0, samp NaN
+    assert math.isnan(by_g["b"][0]) and by_g["b"][1] == 0.0
+    assert math.isnan(by_g["b"][2])
+    # group c: single pair as well
+    assert by_g["c"][1] == 0.0
+    # group d: no complete pairs -> NULL everywhere
+    assert by_g["d"][:3] == (None, None, None)
+
+
+def test_corr_engines_agree_and_sql():
+    def build(s):
+        df = s.create_dataframe(BDATA, num_partitions=3)
+        return (df.group_by("g")
+                .agg(F.corr("x", "y").alias("r"),
+                     F.covar_samp("x", "y").alias("cs"),
+                     F.sum("x").alias("sx"))
+                .order_by("g"))
+
+    assert_tpu_cpu_equal(build, approx=True, ignore_order=False)
+
+    def build_sql(s):
+        s.register_view("t", s.create_dataframe(BDATA, num_partitions=2))
+        return s.sql("SELECT g, corr(x, y) AS r, covar_pop(x, y) AS cp "
+                     "FROM t GROUP BY g ORDER BY g")
+
+    assert_tpu_cpu_equal(build_sql, approx=True, ignore_order=False)
+
+
+def test_corr_constant_series_is_nan():
+    s = tpu_session()
+    df = s.create_dataframe(
+        {"x": (T.DOUBLE, [3.0, 3.0, 3.0]),
+         "y": (T.DOUBLE, [1.0, 2.0, 3.0])}, num_partitions=1)
+    row = df.agg(F.corr("x", "y").alias("r")).collect()[0]
+    assert math.isnan(row[0])  # zero variance -> NaN (Spark)
