@@ -137,7 +137,8 @@ def test_pyspark_dataframe_api_surface():
         assert hasattr(GroupedData, m), f"GroupedData.{m} missing"
     fns = ["col", "lit", "sum", "count", "avg", "min", "max", "first",
            "last", "count_distinct", "percentile", "stddev",
-           "stddev_pop", "variance", "var_pop", "grouping_id", "when",
+           "stddev_pop", "variance", "var_pop", "corr", "covar_pop",
+           "covar_samp", "hex", "grouping_id", "when",
            "coalesce", "concat", "substring", "substring_index", "split",
            "initcap", "upper", "lower", "regexp_replace", "broadcast",
            "row_number", "rank", "dense_rank", "lag", "lead", "hash",
