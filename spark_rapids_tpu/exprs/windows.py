@@ -7,7 +7,10 @@ the whole partition by (partition keys, order keys) once, derives partition
 segmented-scan primitives — O(n log n) sort + O(n) scans, ideal XLA shapes.
 
 Supported frames: ROWS/RANGE with UNBOUNDED PRECEDING..CURRENT ROW (running,
-RANGE extends to peers), UNBOUNDED..UNBOUNDED (whole partition), and bounded
+RANGE extends to peers), UNBOUNDED..UNBOUNDED (whole partition), bounded
+value-based RANGE BETWEEN x PRECEDING AND y FOLLOWING over a single
+numeric/date/timestamp order key (binary search on the sorted span;
+NULL/NaN keys frame over their peer blocks), and bounded
 ROWS frames for sum/count/avg/min/max via prefix sums (min/max bounded uses a
 log-steps sliding reduction).
 """
@@ -151,9 +154,18 @@ class WindowExpression(Expression):
             r = fn.tpu_supported(conf)
             if r:
                 return r
-            if self.frame.kind == "rows" and not (
-                    self.frame.is_running or self.frame.is_unbounded_whole):
-                # bounded rows frames supported for these aggs
-                return None
+            if self.frame.kind == "range" and not (
+                    self.frame.is_running or
+                    self.frame.is_unbounded_whole):
+                # bounded value-range frame: Spark requires exactly one
+                # numeric/date/timestamp order key; anything else routes
+                # to the CPU exec, which raises the analysis error
+                if len(self.order_by) != 1:
+                    return ("bounded RANGE frame needs exactly one "
+                            "ORDER BY expression")
+                kd = self.order_by[0].child.dtype
+                if not kd.is_numeric and kd not in (T.DATE, T.TIMESTAMP):
+                    return ("bounded RANGE frame needs a numeric "
+                            "order key")
             return None
         return f"window function {fn.name} not supported"

@@ -493,13 +493,31 @@ class WindowSpec:
 
     orderBy = order_by
 
-    def rows_between(self, start, end) -> "WindowSpec":
-        s = None if start in (Window.unboundedPreceding, None) else int(start)
-        e = None if end in (Window.unboundedFollowing, None) else int(end)
+    def _make_frame(self, kind: str, start, end) -> "WindowSpec":
+        def bound(v, what):
+            if v is None or v in (Window.unboundedPreceding,
+                                  Window.unboundedFollowing):
+                return None
+            if isinstance(v, bool) or not isinstance(v, int):
+                raise TypeError(
+                    f"{kind} frame {what} bound must be an int, "
+                    f"got {v!r}")
+            return v
         return WindowSpec(self._partition_by, self._order_by,
-                          W.WindowFrame("rows", s, e))
+                          W.WindowFrame(kind, bound(start, "start"),
+                                        bound(end, "end")))
+
+    def rows_between(self, start, end) -> "WindowSpec":
+        return self._make_frame("rows", start, end)
 
     rowsBetween = rows_between
+
+    def range_between(self, start, end) -> "WindowSpec":
+        """Value-based frame over the single numeric order key (RANGE
+        BETWEEN x PRECEDING AND y FOLLOWING)."""
+        return self._make_frame("range", start, end)
+
+    rangeBetween = range_between
 
 
 class Window:

@@ -98,13 +98,85 @@ class _Segments:
         self.change = change
 
 
-def _frame_bounds(frame: WindowFrame, segs: _Segments):
+def _search_boundary(keys, target, lo0, hi0, strict: bool):
+    """Vectorized binary search: first position p in [lo0, hi0+1) with
+    keys[p] > target (strict) or >= target; hi0+1 when none.  keys must
+    ascend within each row's [lo0, hi0] span."""
+    cap = int(keys.shape[0])
+    lo = lo0.astype(jnp.int32)
+    hi = (hi0 + 1).astype(jnp.int32)
+    for _ in range(cap.bit_length() + 1):
+        active = lo < hi
+        mid = (lo + hi) // 2
+        kv = keys[jnp.clip(mid, 0, cap - 1)]
+        pred = (kv > target) if strict else (kv >= target)
+        hi = jnp.where(active & pred, mid, hi)
+        lo = jnp.where(active & ~pred, mid + 1, lo)
+    return lo
+
+
+def _bounded_range_bounds(frame: WindowFrame, segs: _Segments,
+                          okey, ascending: bool, nulls_first: bool):
+    """Value-based RANGE frame bounds: rows whose single numeric order
+    key lies in [k+start, k+end].  NULL and NaN keys frame over their
+    peer block (Spark: each is only a peer of its own kind); the search
+    span excludes those contiguous blocks so the keys stay monotone.
+    UNBOUNDED bounds reach the partition edge (null blocks included),
+    matching Spark's partition-boundary semantics."""
+    kd = okey.data
+    # widen so k + offset cannot wrap in a narrow key dtype
+    kd = kd.astype(jnp.int64) if okey.dtype.is_integral or         okey.dtype in (T.DATE, T.TIMESTAMP) else kd.astype(jnp.float64)
+    keys = kd if ascending else -kd
+    is_nan = jnp.isnan(keys) if okey.dtype.is_fractional else         jnp.zeros_like(okey.validity)
+    finite = okey.validity & ~is_nan
+    cap = segs.pos.shape[0]
+
+    def seg_count(mask):
+        return jax.ops.segment_sum(
+            (mask & segs.live).astype(jnp.int32), segs.seg_ids,
+            num_segments=cap, indices_are_sorted=True)[segs.seg_ids]
+
+    nulls_in_seg = seg_count(~okey.validity)
+    nans_in_seg = seg_count(is_nan)
+    # nulls sit at the span edge given by nulls_first; NaN sorts past
+    # every finite value (Spark), i.e. last ascending / first descending
+    lo0 = segs.seg_start_pos + jnp.where(nulls_first, nulls_in_seg, 0)
+    hi0 = segs.seg_end_pos - jnp.where(nulls_first, 0, nulls_in_seg)
+    if ascending:
+        hi0 = hi0 - nans_in_seg
+    else:
+        lo0 = lo0 + nans_in_seg
+    k = keys
+    if frame.start is None:
+        a = segs.seg_start_pos  # partition edge, null/NaN blocks included
+    else:
+        a = _search_boundary(keys, k + frame.start, lo0, hi0,
+                             strict=False)
+    if frame.end is None:
+        b = segs.seg_end_pos
+    else:
+        b = _search_boundary(keys, k + frame.end, lo0, hi0,
+                             strict=True) - 1
+    a = jnp.where(finite, a, segs.peer_start_pos)
+    b = jnp.where(finite, b, segs.peer_end_pos)
+    return a, b
+
+
+def _frame_bounds(frame: WindowFrame, segs: _Segments, okeys=None,
+                  order_by=None):
     """(a, b) inclusive row-position bounds of the frame per row."""
     if frame.is_unbounded_whole:
         return segs.seg_start_pos, segs.seg_end_pos
     if frame.kind == "range":
-        # running with peers (the only supported range frame)
-        return segs.seg_start_pos, segs.peer_end_pos
+        if frame.is_running:
+            return segs.seg_start_pos, segs.peer_end_pos
+        # bounded value range: exactly one numeric order key (validated
+        # by WindowExpression.tpu_supported)
+        assert okeys is not None and len(okeys) == 1, \
+            "bounded RANGE frame needs exactly one order key"
+        o = order_by[0]
+        return _bounded_range_bounds(frame, segs, okeys[0],
+                                     o.ascending, o.nulls_first)
     a = segs.seg_start_pos if frame.start is None else \
         jnp.maximum(segs.pos + frame.start, segs.seg_start_pos)
     b = segs.seg_end_pos if frame.end is None else \
@@ -113,7 +185,8 @@ def _frame_bounds(frame: WindowFrame, segs: _Segments):
 
 
 def _eval_window_fn(w: WindowExpression, segs: _Segments,
-                    sorted_batch: ColumnBatch, ctx: TpuEvalCtx) -> DevVal:
+                    sorted_batch: ColumnBatch, ctx: TpuEvalCtx,
+                    sorted_okeys=None) -> DevVal:
     fn = w.function
     cap = sorted_batch.capacity
     one = jnp.int32(1)
@@ -152,7 +225,7 @@ def _eval_window_fn(w: WindowExpression, segs: _Segments,
         return DevVal(v.dtype, data, validity & segs.live)
     if isinstance(fn, AggregateFunction):
         v = fn.child.tpu_eval(ctx)
-        a, b = _frame_bounds(w.frame, segs)
+        a, b = _frame_bounds(w.frame, segs, sorted_okeys, w.order_by)
         valid = v.validity & segs.live
         cnt_prefix = _prefix_incl(valid.astype(jnp.int64))
         frame_cnt = _range_sum(cnt_prefix, a, b)
@@ -244,7 +317,7 @@ class TpuWindowExec(TpuExec):
 
         cols = list(sorted_batch.columns)
         for w in self.window_exprs:
-            v = _eval_window_fn(w, segs, sorted_batch, ctx)
+            v = _eval_window_fn(w, segs, sorted_batch, ctx, sorted_okeys)
             cols.append(DeviceColumn(v.dtype, v.data, v.validity, v.offsets))
         return ColumnBatch(self.output_schema, cols, batch.num_rows, cap)
 
@@ -358,7 +431,7 @@ class CpuWindowExec(CpuExec):
             vals, valid = v.values, v.validity
             out = []
             for j in range(m):
-                a, b = self._bounds(w.frame, j, m, g, okey)
+                a, b = self._bounds(w, j, m, g, okey)
                 sel = [g[k] for k in range(a, b + 1)] if b >= a else []
                 import numpy as np
                 gv = np.array([vals[i] for i in sel]) if sel else \
@@ -369,14 +442,72 @@ class CpuWindowExec(CpuExec):
             return out
         raise NotImplementedError(fn.name)
 
-    def _bounds(self, frame: WindowFrame, j: int, m: int, g, okey):
+    def _bounds(self, w: WindowExpression, j: int, m: int, g, okey):
+        frame = w.frame
         if frame.is_unbounded_whole:
             return 0, m - 1
         if frame.kind == "range":
-            b = j
-            while b + 1 < m and okey[g[b + 1]] == okey[g[j]]:
-                b += 1
-            return 0, b
+            if frame.is_running:
+                b = j
+                while b + 1 < m and okey[g[b + 1]] == okey[g[j]]:
+                    b += 1
+                return 0, b
+            # bounded value range over the single numeric order key
+            if len(w.order_by) != 1:
+                raise ValueError(
+                    "a bounded RANGE frame requires exactly one "
+                    "ORDER BY expression")
+            o = w.order_by[0]
+            kd = o.child.dtype
+            if not kd.is_numeric and kd not in (T.DATE, T.TIMESTAMP):
+                raise ValueError(
+                    f"bounded RANGE frames need a numeric order key, "
+                    f"got {kd}")
+            sgn = 1 if o.ascending else -1
+            # okey entries are tuples over all order keys; bounded range
+            # has exactly one
+            kv = [okey[g[i]][0] for i in range(m)]
+            k = kv[j]
+            def _is_nan(v):
+                return v is not None and v != v
+
+            if _is_nan(k):
+                # NaN keys frame over their peer (NaN) block
+                a = j
+                while a - 1 >= 0 and _is_nan(kv[a - 1]):
+                    a -= 1
+                b = j
+                while b + 1 < m and _is_nan(kv[b + 1]):
+                    b += 1
+                return a, b
+            if k is None:
+                # NULL keys frame over their peer (null) block
+                a = j
+                while a - 1 >= 0 and kv[a - 1] is None:
+                    a -= 1
+                b = j
+                while b + 1 < m and kv[b + 1] is None:
+                    b += 1
+                return a, b
+            lo_v = None if frame.start is None else k + sgn * frame.start
+            hi_v = None if frame.end is None else k + sgn * frame.end
+
+            def inside(v):
+                if lo_v is not None and sgn * v < sgn * lo_v:
+                    return False
+                if hi_v is not None and sgn * v > sgn * hi_v:
+                    return False
+                return True
+
+            def finite(v):
+                return v is not None and v == v  # excludes NULL and NaN
+
+            hits = [i for i in range(m) if finite(kv[i]) and inside(kv[i])]
+            # UNBOUNDED bounds reach the partition edge (incl. the
+            # null/NaN blocks), matching Spark
+            a = 0 if frame.start is None else (hits[0] if hits else m)
+            b = m - 1 if frame.end is None else (hits[-1] if hits else -1)
+            return a, b
         a = 0 if frame.start is None else max(0, j + frame.start)
         b = m - 1 if frame.end is None else min(m - 1, j + frame.end)
         return a, b

@@ -78,3 +78,146 @@ def test_window_no_partition():
         w = Window.order_by("x", "v")
         return make_df(s).with_column("rn", F.row_number().over(w))
     assert_tpu_cpu_equal(q)
+
+
+class TestBoundedRangeFrames:
+    """Value-based RANGE BETWEEN x PRECEDING AND y FOLLOWING frames."""
+
+    DATA = {"g": (T.STRING, ["a"] * 6 + ["b"] * 3),
+            "k": (T.INT, [1, 2, 4, 7, 7, 12, 5, None, 9]),
+            "v": (T.DOUBLE, [1.0, 2.0, 4.0, 7.0, 7.5, 12.0, 5.0, 100.0,
+                             9.0])}
+
+    def test_bounded_range_sum_ground_truth(self):
+        from compare import tpu_session
+        s = tpu_session()
+        df = s.create_dataframe(self.DATA, num_partitions=2)
+        w = F.Window.partition_by("g").order_by("k") \
+            .range_between(-2, 2)
+        rows = (df.with_column("rs", F.sum("v").over(w))
+                .order_by("g", "k", "v").collect())
+        by = {(r[0], r[1], r[2]): r[3] for r in rows}
+        # g=a, k=1: values with k in [-1, 3] -> v(1) + v(2) = 3.0
+        assert by[("a", 1, 1.0)] == 3.0
+        # k=4: [2, 6] -> 2.0 + 4.0 = 6.0
+        assert by[("a", 4, 4.0)] == 6.0
+        # k=7 rows: [5, 9] -> 7.0 + 7.5 (peers both included)
+        assert by[("a", 7, 7.0)] == 14.5
+        # k=12: [10, 14] -> only itself
+        assert by[("a", 12, 12.0)] == 12.0
+        # NULL key frames over the null peer block only
+        assert by[("b", None, 100.0)] == 100.0
+        assert by[("b", 5, 5.0)] == 5.0   # [3,7]: only k=5
+        assert by[("b", 9, 9.0)] == 9.0
+
+    def test_bounded_range_engines_agree(self):
+        def build(s):
+            df = s.create_dataframe(self.DATA, num_partitions=3)
+            w = F.Window.partition_by("g").order_by("k") \
+                .range_between(-3, 1)
+            return (df.with_column("rs", F.sum("v").over(w))
+                    .with_column("rc", F.count("v").over(w))
+                    .with_column("rm", F.max("v").over(w))
+                    .order_by("g", "k", "v"))
+
+        assert_tpu_cpu_equal(build, approx=True, ignore_order=False)
+
+    def test_bounded_range_desc(self):
+        def build(s):
+            df = s.create_dataframe(self.DATA, num_partitions=2)
+            w = F.Window.partition_by("g") \
+                .order_by(F.col("k").desc()).range_between(-2, 0)
+            return (df.with_column("rs", F.sum("v").over(w))
+                    .order_by("g", "k", "v"))
+
+        assert_tpu_cpu_equal(build, approx=True, ignore_order=False)
+
+    def test_bounded_range_sql(self):
+        def build(s):
+            s.register_view("t", s.create_dataframe(self.DATA,
+                                                    num_partitions=2))
+            return s.sql(
+                "SELECT g, k, v, sum(v) OVER (PARTITION BY g ORDER BY k "
+                "RANGE BETWEEN 2 PRECEDING AND 2 FOLLOWING) AS rs "
+                "FROM t ORDER BY g, k, v")
+
+        assert_tpu_cpu_equal(build, approx=True, ignore_order=False)
+
+    def test_bounded_range_unbounded_start_includes_null_block(self):
+        from compare import tpu_session
+        s = tpu_session()
+        df = s.create_dataframe(
+            {"k": (T.INT, [None, 1, 2]),
+             "v": (T.DOUBLE, [10.0, 1.0, 2.0])}, num_partitions=1)
+        w = F.Window.order_by("k").range_between(
+            F.Window.unboundedPreceding, 1)
+        rows = (df.with_column("rs", F.sum("v").over(w))
+                .order_by("k", "v").collect())
+        by = {r[0]: r[2] for r in rows}
+        # UNBOUNDED PRECEDING reaches the partition start: the null row
+        # is inside the k=1 row's frame (Spark partition-edge semantics)
+        assert by[1] == 13.0
+        assert by[2] == 13.0
+
+        def build(s2):
+            d = s2.create_dataframe(
+                {"k": (T.INT, [None, 1, 2]),
+                 "v": (T.DOUBLE, [10.0, 1.0, 2.0])}, num_partitions=2)
+            w2 = F.Window.order_by("k").range_between(
+                F.Window.unboundedPreceding, 1)
+            return (d.with_column("rs", F.sum("v").over(w2))
+                    .order_by("k", "v"))
+
+        assert_tpu_cpu_equal(build, approx=True, ignore_order=False)
+
+    def test_bounded_range_nan_peer_block(self):
+        def build(s):
+            d = s.create_dataframe(
+                {"k": (T.DOUBLE, [1.0, 2.0, float("nan"), float("nan"),
+                                  None]),
+                 "v": (T.DOUBLE, [1.0, 2.0, 30.0, 40.0, 500.0])},
+                num_partitions=2)
+            w = F.Window.order_by("k").range_between(-1, 1)
+            return (d.with_column("rs", F.sum("v").over(w))
+                    .order_by("k", "v"))
+
+        assert_tpu_cpu_equal(build, approx=True, ignore_order=False)
+        from compare import tpu_session
+        s = tpu_session()
+        d = s.create_dataframe(
+            {"k": (T.DOUBLE, [1.0, 2.0, float("nan"), float("nan")]),
+             "v": (T.DOUBLE, [1.0, 2.0, 30.0, 40.0])}, num_partitions=1)
+        w = F.Window.order_by("k").range_between(-1, 1)
+        rows = d.with_column("rs", F.sum("v").over(w)).collect()
+        by = {r[0]: r[2] for r in rows
+              if r[0] is not None and r[0] == r[0]}
+        assert by[1.0] == 3.0 and by[2.0] == 3.0
+        nan_sums = [r[2] for r in rows
+                    if r[0] is not None and r[0] != r[0]]
+        assert nan_sums == [70.0, 70.0]  # NaN rows frame over NaN peers
+
+    def test_bounded_range_narrow_key_no_overflow(self):
+        def build(s):
+            d = s.create_dataframe(
+                {"k": (T.INT, [2147483640, 2147483645, 2147483646]),
+                 "v": (T.DOUBLE, [1.0, 2.0, 4.0])}, num_partitions=1)
+            w = F.Window.order_by("k").range_between(0, 10)
+            return (d.with_column("rs", F.sum("v").over(w))
+                    .order_by("k"))
+
+        assert_tpu_cpu_equal(build, approx=True, ignore_order=False)
+        from compare import tpu_session
+        s = tpu_session()
+        d = s.create_dataframe(
+            {"k": (T.INT, [2147483640, 2147483645, 2147483646]),
+             "v": (T.DOUBLE, [1.0, 2.0, 4.0])}, num_partitions=1)
+        w = F.Window.order_by("k").range_between(0, 10)
+        rows = d.with_column("rs", F.sum("v").over(w)).order_by(
+            "k").collect()
+        # k + 10 exceeds int32 max: must widen, not wrap to an empty frame
+        assert [r[2] for r in rows] == [7.0, 6.0, 4.0]
+
+    def test_range_between_rejects_float_bounds(self):
+        import pytest as _pt
+        with _pt.raises(TypeError):
+            F.Window.order_by("k").range_between(-0.5, 0.5)
