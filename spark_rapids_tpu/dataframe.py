@@ -662,6 +662,83 @@ class DataFrame:
     def sample(self, fraction: float, seed: int = 42) -> "DataFrame":
         return DataFrame(L.Sample(fraction, seed, self.plan), self.session)
 
+    # -- stat functions (pyspark DataFrameStatFunctions surface) ------------
+
+    def crosstab(self, col1: str, col2: str) -> "DataFrame":
+        """Pairwise frequency table (pyspark crosstab): one row per col1
+        value, one column per col2 value, cells = pair counts (0 when
+        absent, as pyspark renders).  Planned as a pivot count."""
+        from spark_rapids_tpu import functions as F
+        out = (self.group_by(col1)
+               .pivot(col2)
+               .agg(F.count("*").alias("n")))
+        first = out.columns[0]
+        # pyspark renders NULL keys as the string "null" on both axes
+        sel = [F.coalesce(out[first].cast(T.STRING), F.lit("null"))
+               .alias(f"{col1}_{col2}")]
+        for c in out.columns[1:]:
+            sel.append(F.coalesce(out[c], F.lit(0)).alias(c))
+        return out.select(*sel)
+
+    def approx_quantile(self, col_name: str, probabilities, rel_err=0.0
+                        ) -> List[float]:
+        """Quantiles of a numeric column (pyspark approxQuantile).  The
+        engine computes EXACT percentiles (rel_err accepted for API
+        compatibility, ignored — exact satisfies any error bound)."""
+        from spark_rapids_tpu import functions as F
+        if not probabilities:
+            return []
+        aggs = [F.percentile(col_name, float(p)).alias(f"q{i}")
+                for i, p in enumerate(probabilities)]
+        row = self.agg(*aggs).collect()[0]
+        return list(row)
+
+    approxQuantile = approx_quantile
+
+    def freq_items(self, cols: List[str], support: float = 0.01
+                   ) -> "DataFrame":
+        """Values occurring in more than ``support`` of rows, one
+        array-typed column per input (pyspark freqItems; this engine
+        computes exact heavy hitters, a superset guarantee of pyspark's
+        sketch)."""
+        from spark_rapids_tpu import functions as F
+        out_data = {}
+        total = None
+        for c in cols:
+            counts = (self.group_by(c)
+                      .agg(F.count("*").alias("__n")).collect())
+            if total is None:  # row count = sum of any column's groups
+                total = sum(n for _, n in counts)
+            thresh = support * total
+            vals = [k for k, n in counts if n > thresh]
+            f = self.schema.field(c)
+            out_data[f"{c}_freqItems"] = (T.ArrayType(f.dtype), [vals])
+        return self.session.create_dataframe(out_data, num_partitions=1)
+
+    freqItems = freq_items
+
+    def sample_by(self, col_name: str, fractions: Dict, seed: int = 42
+                  ) -> "DataFrame":
+        """Stratified sample without replacement (pyspark sampleBy):
+        each row kept with its key's fraction; keys absent from
+        ``fractions`` are dropped."""
+        from spark_rapids_tpu import functions as F
+        for k, f in fractions.items():
+            if not (0.0 <= float(f) <= 1.0):
+                raise ValueError(f"fraction for {k!r} must be in [0, 1]")
+        if not fractions:  # pyspark: empty strata -> empty sample
+            return self.filter(F.lit(False))
+        key = self[col_name]
+        frac = None
+        for k, f in fractions.items():
+            branch = (key.is_null() if k is None else (key == k))
+            frac = F.when(branch, float(f)) if frac is None \
+                else frac.when(branch, float(f))
+        frac_col = frac.otherwise(0.0)
+        return self.filter(F.rand(seed) < frac_col)
+
+    sampleBy = sample_by
+
     # -- actions ------------------------------------------------------------
 
     def collect(self) -> List[tuple]:

@@ -1,0 +1,84 @@
+"""DataFrameStatFunctions surface: crosstab, approx_quantile,
+freq_items, sample_by."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu import types as T
+
+from compare import assert_tpu_cpu_equal, tpu_session
+
+DATA = {"k": (T.STRING, ["a", "a", "a", "b", "b", "c"] * 10),
+        "p": (T.STRING, ["x", "y", "x", "x", None, "y"] * 10),
+        "v": (T.DOUBLE, [float(i) for i in range(60)])}
+
+
+def test_crosstab():
+    s = tpu_session()
+    df = s.create_dataframe(DATA, num_partitions=2)
+    out = df.crosstab("k", "p").order_by("k_p")
+    assert out.columns == ["k_p", "null", "x", "y"]
+    rows = {r[0]: r[1:] for r in out.collect()}
+    assert rows["a"] == (0, 20, 10)
+    assert rows["b"] == (10, 10, 0)
+    assert rows["c"] == (0, 0, 10)
+
+    def build(s2):
+        d = s2.create_dataframe(DATA, num_partitions=3)
+        return d.crosstab("k", "p").order_by("k_p")
+
+    assert_tpu_cpu_equal(build, ignore_order=False)
+
+
+def test_approx_quantile_exact():
+    s = tpu_session()
+    df = s.create_dataframe(DATA, num_partitions=3)
+    qs = df.approx_quantile("v", [0.0, 0.5, 1.0])
+    vals = np.arange(60.0)
+    assert qs[0] == 0.0 and qs[2] == 59.0
+    assert qs[1] == pytest.approx(float(np.percentile(vals, 50)))
+
+
+def test_freq_items():
+    s = tpu_session()
+    df = s.create_dataframe(DATA, num_partitions=2)
+    out = df.freq_items(["k"], support=0.4).collect()
+    # only 'a' (30/60) crosses 40% -- wait: 30/60 = 0.5 > 0.4; b = 20/60
+    assert out[0][0] == ["a"]
+    out = df.freq_items(["k"], support=0.1).collect()
+    assert sorted(out[0][0]) == ["a", "b", "c"]
+
+
+def test_sample_by():
+    s = tpu_session()
+    df = s.create_dataframe(DATA, num_partitions=2)
+    out = df.sample_by("k", {"a": 1.0, "b": 0.0}).collect()
+    ks = [r[0] for r in out]
+    assert set(ks) == {"a"} and len(ks) == 30  # all a's, no b's, c dropped
+    with pytest.raises(ValueError):
+        df.sample_by("k", {"a": 1.5})
+
+    # rand() draws depend on the physical plan (as in Spark), so no
+    # cross-engine row equality; assert the strata guarantees instead
+    out = df.sample_by("k", {"a": 0.5, "c": 1.0}, seed=7).collect()
+    ks = [r[0] for r in out]
+    assert "b" not in ks                     # absent keys dropped
+    assert ks.count("c") == 10               # fraction 1.0 keeps all
+    assert 0 <= ks.count("a") <= 30          # fraction 0.5 subset
+    # deterministic per engine+seed
+    again = df.sample_by("k", {"a": 0.5, "c": 1.0}, seed=7).collect()
+    assert out == again
+
+
+def test_stat_functions_edge_cases():
+    s = tpu_session()
+    df = s.create_dataframe(DATA, num_partitions=1)
+    assert df.approx_quantile("v", []) == []
+    assert df.sample_by("k", {}).collect() == []
+    d2 = s.create_dataframe(
+        {"k": (T.STRING, ["a", None, "b"]),
+         "p": (T.STRING, ["x", "x", None])}, num_partitions=1)
+    rows = d2.crosstab("k", "p").order_by("k_p").collect()
+    keys = [r[0] for r in rows]
+    assert "null" in keys  # NULL key rendered as the string "null"
