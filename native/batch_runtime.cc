@@ -267,3 +267,121 @@ void pmod_partition(const uint32_t* h, int64_t n, int32_t n_parts,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Block compression: LZ4-style byte-oriented LZ77 (token = 4-bit literal
+// run + 4-bit match run, 2-byte offsets, 255-run length extensions).
+// The TableCompressionCodec native path (the reference links nvcomp for
+// this role; here a dependency-free host codec for spill/shuffle bytes).
+// ---------------------------------------------------------------------------
+
+extern "C" uint64_t lz_compress_bound(uint64_t n) {
+  return n + n / 255 + 16;
+}
+
+static inline uint32_t lz_hash4(uint32_t v) {
+  return (v * 2654435761u) >> 18;  // 14-bit bucket
+}
+
+extern "C" uint64_t lz_compress(const uint8_t* src, uint64_t n,
+                                uint8_t* dst, uint64_t cap) {
+  // Returns bytes written, 0 when dst cannot hold the output.
+  const uint32_t HT = 1u << 14;
+  static thread_local uint32_t table[1u << 14];
+  memset(table, 0, sizeof(table));
+
+  uint64_t si = 0, di = 0, anchor = 0;
+
+  auto emit_run = [&](uint64_t r) {  // 255-run extension bytes
+    while (r >= 255) {
+      if (di >= cap) return false;
+      dst[di++] = 255; r -= 255;
+    }
+    if (di >= cap) return false;
+    dst[di++] = (uint8_t)r;
+    return true;
+  };
+
+  if (n >= 13) {
+    uint64_t limit = n - 12;
+    while (si < limit) {
+      uint32_t seq;
+      memcpy(&seq, src + si, 4);
+      uint32_t h = lz_hash4(seq) & (HT - 1);
+      uint64_t cand = table[h] ? (uint64_t)(table[h] - 1) : UINT64_MAX;
+      if (si + 1 <= 0xFFFFFFFFull) table[h] = (uint32_t)(si + 1);
+      uint32_t cseq = 0;
+      bool hit = cand != UINT64_MAX && si - cand <= 65535 &&
+                 (memcpy(&cseq, src + cand, 4), cseq == seq);
+      if (!hit) { si++; continue; }
+      uint64_t m = si + 4, c = cand + 4;
+      while (m < n && src[m] == src[c]) { m++; c++; }
+      uint64_t lit = si - anchor;
+      uint64_t mlen = (m - si) - 4;
+      uint8_t tl = lit >= 15 ? 15 : (uint8_t)lit;
+      uint8_t tm = mlen >= 15 ? 15 : (uint8_t)mlen;
+      if (di + 1 + lit + 2 + 8 + lit / 255 + mlen / 255 > cap) return 0;
+      dst[di++] = (uint8_t)((tl << 4) | tm);
+      if (lit >= 15 && !emit_run(lit - 15)) return 0;
+      memcpy(dst + di, src + anchor, lit);
+      di += lit;
+      uint16_t off = (uint16_t)(si - cand);
+      dst[di++] = (uint8_t)(off & 0xFF);
+      dst[di++] = (uint8_t)(off >> 8);
+      if (mlen >= 15 && !emit_run(mlen - 15)) return 0;
+      si = m;
+      anchor = m;
+    }
+  }
+  // trailing literals-only block (no offset follows)
+  uint64_t lit = n - anchor;
+  uint8_t tl = lit >= 15 ? 15 : (uint8_t)lit;
+  if (di + 1 + lit + lit / 255 + 1 > cap) return 0;
+  dst[di++] = (uint8_t)(tl << 4);
+  if (lit >= 15 && !emit_run(lit - 15)) return 0;
+  memcpy(dst + di, src + anchor, lit);
+  di += lit;
+  return di;
+}
+
+extern "C" int32_t lz_decompress(const uint8_t* src, uint64_t n,
+                                 uint8_t* dst, uint64_t out_n) {
+  // 0 on success (exactly out_n bytes produced), -1 on malformed input.
+  uint64_t si = 0, di = 0;
+  while (si < n) {
+    uint8_t tok = src[si++];
+    uint64_t lit = tok >> 4;
+    if (lit == 15) {
+      uint8_t b;
+      do {
+        if (si >= n) return -1;
+        b = src[si++];
+        lit += b;
+      } while (b == 255);
+    }
+    if (si + lit > n || di + lit > out_n) return -1;
+    memcpy(dst + di, src + si, lit);
+    si += lit;
+    di += lit;
+    if (si >= n) break;  // trailing literals-only block
+    if (si + 2 > n) return -1;
+    uint64_t off = (uint64_t)src[si] | ((uint64_t)src[si + 1] << 8);
+    si += 2;
+    uint64_t mlen = tok & 15;
+    if (mlen == 15) {
+      uint8_t b;
+      do {
+        if (si >= n) return -1;
+        b = src[si++];
+        mlen += b;
+      } while (b == 255);
+    }
+    mlen += 4;
+    if (off == 0 || off > di || di + mlen > out_n) return -1;
+    for (uint64_t k = 0; k < mlen; k++) {  // overlap-safe byte copy
+      dst[di] = dst[di - off];
+      di++;
+    }
+  }
+  return di == out_n ? 0 : -1;
+}

@@ -667,13 +667,17 @@ class DataFrame:
     def crosstab(self, col1: str, col2: str) -> "DataFrame":
         """Pairwise frequency table (pyspark crosstab): one row per col1
         value, one column per col2 value, cells = pair counts (0 when
-        absent, as pyspark renders).  Planned as a pivot count."""
+        absent).  NULL keys render as the string "null" on both axes and
+        MERGE with a literal "null" value (one column/row, summed counts
+        — pyspark emits a duplicate column name there)."""
         from spark_rapids_tpu import functions as F
-        out = (self.group_by(col1)
-               .pivot(col2)
+        tmp = "__ct_p"
+        normalized = self.with_column(
+            tmp, F.coalesce(self[col2].cast(T.STRING), F.lit("null")))
+        out = (normalized.group_by(col1)
+               .pivot(tmp)
                .agg(F.count("*").alias("n")))
         first = out.columns[0]
-        # pyspark renders NULL keys as the string "null" on both axes
         sel = [F.coalesce(out[first].cast(T.STRING), F.lit("null"))
                .alias(f"{col1}_{col2}")]
         for c in out.columns[1:]:
@@ -691,6 +695,8 @@ class DataFrame:
         aggs = [F.percentile(col_name, float(p)).alias(f"q{i}")
                 for i, p in enumerate(probabilities)]
         row = self.agg(*aggs).collect()[0]
+        if all(v is None for v in row):
+            return []  # no non-null values (pyspark returns [])
         return list(row)
 
     approxQuantile = approx_quantile
@@ -703,14 +709,13 @@ class DataFrame:
         sketch)."""
         from spark_rapids_tpu import functions as F
         out_data = {}
-        total = None
+        thresh = support * self.count()
         for c in cols:
-            counts = (self.group_by(c)
-                      .agg(F.count("*").alias("__n")).collect())
-            if total is None:  # row count = sum of any column's groups
-                total = sum(n for _, n in counts)
-            thresh = support * total
-            vals = [k for k, n in counts if n > thresh]
+            # threshold applied engine-side: the driver only receives
+            # frequent values, never the full distinct set
+            g = (self.group_by(c).agg(F.count("*").alias("__n")))
+            vals = [k for k, _ in
+                    g.filter(g["__n"] > float(thresh)).collect()]
             f = self.schema.field(c)
             out_data[f"{c}_freqItems"] = (T.ArrayType(f.dtype), [vals])
         return self.session.create_dataframe(out_data, num_partitions=1)

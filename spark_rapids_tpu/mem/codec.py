@@ -40,10 +40,42 @@ class ZlibCodec(Codec):
         return zlib.decompress(data)
 
 
+class NativeLZCodec(Codec):
+    """C++ LZ4-style block codec (native/batch_runtime.cc lz_*): the
+    TableCompressionCodec fast path.  A 1-byte header marks whether the
+    block is compressed or stored raw (incompressible input, or the
+    native library unavailable at compress time), so decompression is
+    self-describing either way."""
+
+    name = "nativelz"
+
+    def compress(self, data: bytes) -> bytes:
+        from spark_rapids_tpu.native_rt import lz_compress
+        enc = lz_compress(data)
+        if enc is None or len(enc) >= len(data):
+            return b"\x00" + data
+        return b"\x01" + enc
+
+    def decompress(self, data: bytes, uncompressed_size: int) -> bytes:
+        if not data:
+            return b""
+        tag, body = data[0], data[1:]
+        if tag == 0:
+            return body
+        from spark_rapids_tpu.native_rt import lz_decompress
+        out = lz_decompress(body, uncompressed_size)
+        if out is None:
+            raise RuntimeError(
+                "nativelz block but the native library is unavailable")
+        return out
+
+
 _CODECS: Dict[str, Callable[[], Codec]] = {
     "copy": CopyCodec,
     "uncompressed": CopyCodec,
     "zlib": ZlibCodec,
+    "nativelz": NativeLZCodec,
+    "lz4": NativeLZCodec,
 }
 
 

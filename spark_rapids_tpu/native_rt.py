@@ -64,6 +64,12 @@ def get_lib():
         lib.arena_destroy.argtypes = [ctypes.c_void_p]
         lib.arena_stats.argtypes = [ctypes.c_void_p, ctypes.POINTER(u64),
                                     ctypes.POINTER(u64), ctypes.POINTER(u64)]
+        lib.lz_compress_bound.restype = u64
+        lib.lz_compress_bound.argtypes = [u64]
+        lib.lz_compress.restype = u64
+        lib.lz_compress.argtypes = [p8, u64, p8, u64]
+        lib.lz_decompress.restype = ctypes.c_int32
+        lib.lz_decompress.argtypes = [p8, u64, p8, u64]
         _lib = lib
         return _lib
 
@@ -314,3 +320,40 @@ class HostArena:
         if self._arena:
             self._lib.arena_destroy(self._arena)
             self._arena = None
+
+
+def lz_compress(data: bytes) -> Optional[bytes]:
+    """Native LZ4-style block compression; None when the library is
+    unavailable or the emit bound is exceeded (caller stores raw)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    import ctypes
+    n = len(data)
+    bound = lib.lz_compress_bound(n)
+    out = ctypes.create_string_buffer(bound)
+    # zero-copy view of the immutable bytes (the C side only reads src)
+    src = ctypes.cast(ctypes.c_char_p(data or b"\x00"),
+                      ctypes.POINTER(ctypes.c_uint8))
+    written = lib.lz_compress(
+        src, n, ctypes.cast(out, ctypes.POINTER(ctypes.c_uint8)), bound)
+    if written == 0 and n > 0:
+        return None
+    return out.raw[:written]
+
+
+def lz_decompress(data: bytes, out_size: int) -> Optional[bytes]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    import ctypes
+    n = len(data)
+    out = ctypes.create_string_buffer(max(out_size, 1))
+    src = ctypes.cast(ctypes.c_char_p(data or b"\x00"),
+                      ctypes.POINTER(ctypes.c_uint8))
+    rc = lib.lz_decompress(
+        src, n, ctypes.cast(out, ctypes.POINTER(ctypes.c_uint8)),
+        out_size)
+    if rc != 0:
+        raise ValueError("corrupt nativelz stream")
+    return out.raw[:out_size]

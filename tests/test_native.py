@@ -62,3 +62,74 @@ def test_empty_batch_roundtrip():
     buf = serialize_host_batch(hb)
     out = deserialize_host_batch(buf, hb.schema)
     assert out.num_rows == 0
+
+
+class TestNativeLZ:
+    def _roundtrip(self, data: bytes):
+        from spark_rapids_tpu.mem.codec import get_codec
+        c = get_codec("nativelz")
+        enc = c.compress(data)
+        assert c.decompress(enc, len(data)) == data
+        return enc
+
+    def test_lz_roundtrip_shapes(self):
+        import os
+        import numpy as np
+        r = np.random.RandomState(5)
+        cases = [
+            b"",
+            b"a",
+            b"abcd" * 3,
+            b"x" * 100_000,                        # highly compressible
+            bytes(r.randint(0, 256, 64_000, dtype=np.uint8)),  # random
+            (b"the quick brown fox " * 5000),
+            bytes(r.randint(0, 4, 300_000, dtype=np.uint8)),
+            os.urandom(13),
+        ]
+        for data in cases:
+            self._roundtrip(data)
+
+    def test_lz_compresses_repetitive_data(self):
+        from spark_rapids_tpu.native_rt import get_lib
+        if get_lib() is None:
+            import pytest
+            pytest.skip("native library unavailable")
+        data = b"ABABABAB" * 50_000
+        enc = self._roundtrip(data)
+        assert len(enc) < len(data) // 10
+
+    def test_lz_rejects_corrupt_stream(self):
+        from spark_rapids_tpu.native_rt import get_lib
+        if get_lib() is None:
+            import pytest
+            pytest.skip("native library unavailable")
+        from spark_rapids_tpu.mem.codec import get_codec
+        import pytest
+        c = get_codec("nativelz")
+        enc = c.compress(b"hello world, hello world, hello world")
+        if enc[0] == 1:  # only the compressed form validates structure
+            with pytest.raises((ValueError, RuntimeError)):
+                c.decompress(b"\x01" + b"\xff\xff\x00\x10" * 4, 37)
+
+    def test_spill_with_nativelz_codec(self):
+        from spark_rapids_tpu.batch import HostBatch, device_to_host, \
+            host_to_device
+        from spark_rapids_tpu.config import RapidsConf
+        from spark_rapids_tpu.mem.catalog import BufferCatalog
+        from spark_rapids_tpu import types as T
+        from conftest import assert_batches_equal
+        conf = RapidsConf({
+            "spark.rapids.memory.tpu.spillBudgetBytes": 1,
+            "spark.rapids.memory.host.spillStorageSize": 1,
+            "spark.rapids.shuffle.compression.codec": "nativelz",
+        })
+        cat = BufferCatalog(conf)
+        data = {"x": (T.INT, list(range(500))),
+                "s": (T.STRING, [f"row-{i}" for i in range(500)])}
+        h1 = cat.register(host_to_device(HostBatch.from_pydict(data)),
+                          priority=1)
+        cat.register(host_to_device(HostBatch.from_pydict(data)),
+                     priority=2)
+        assert cat.metrics["spilled_to_disk"] >= 1
+        got = device_to_host(h1.get()).to_pydict()
+        assert_batches_equal(HostBatch.from_pydict(data).to_pydict(), got)
