@@ -322,18 +322,7 @@ class FromUnixTime(UnaryExpression):
             jnp.full(cap, 58, jnp.uint8),
             dig(ss, 10), dig(ss, 1),
         ]
-        mat = jnp.stack(cols, axis=1)  # [cap, 19]
-        live = v.validity & ctx.row_mask
-        lens = jnp.where(live, 19, 0).astype(jnp.int32)
-        offsets = jnp.concatenate([
-            jnp.zeros(1, jnp.int32), jnp.cumsum(lens).astype(jnp.int32)])
-        nbytes = cap * 19
-        pos = jnp.arange(nbytes, dtype=jnp.int32)
-        row = jnp.clip(jnp.searchsorted(offsets[1:], pos, side="right"),
-                       0, cap - 1).astype(jnp.int32)
-        within = jnp.clip(pos - offsets[row], 0, 18)
-        data = jnp.where(pos < offsets[-1], mat[row, within], 0)
-        return DevVal(T.STRING, data.astype(jnp.uint8), v.validity, offsets)
+        return _emit_fixed_width(cols, v.validity, ctx)
 
     def cpu_eval(self, ctx) -> CpuVal:
         import datetime as _dt
@@ -393,3 +382,204 @@ class TimeAdd(UnaryExpression):
         v = self.child.cpu_eval(ctx)
         return CpuVal(T.TIMESTAMP, v.values + self.interval_micros,
                       v.validity)
+
+
+def _emit_fixed_width(cols, validity, ctx) -> DevVal:
+    """Materialize a fixed-width-per-row string column from byte columns
+    (shared by the device date/time renderers)."""
+    cap = ctx.capacity
+    width = len(cols)
+    mat = jnp.stack(cols, axis=1)  # [cap, width]
+    live = validity & ctx.row_mask
+    lens = jnp.where(live, width, 0).astype(jnp.int32)
+    offsets = jnp.concatenate([
+        jnp.zeros(1, jnp.int32), jnp.cumsum(lens).astype(jnp.int32)])
+    pos = jnp.arange(cap * width, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(offsets[1:], pos, side="right"),
+                   0, cap - 1).astype(jnp.int32)
+    within = jnp.clip(pos - offsets[row], 0, width - 1)
+    data = jnp.where(pos < offsets[-1], mat[row, within], 0)
+    return DevVal(T.STRING, data.astype(jnp.uint8), validity, offsets)
+
+
+_JAVA_TO_STRPTIME = [("yyyy", "%Y"), ("MM", "%m"), ("dd", "%d"),
+                     ("HH", "%H"), ("mm", "%M"), ("ss", "%S")]
+_JAVA_TOKENS = {j for j, _ in _JAVA_TO_STRPTIME}
+
+
+def _java_fmt_to_strptime(fmt: str) -> str:
+    """Translate the supported java-format subset; reject anything with
+    letter tokens outside it (a blind replace would mangle e.g. MMM
+    into %mM and silently NULL every row)."""
+    import re
+    for tok in re.findall(r"[A-Za-z]+", fmt):
+        if tok not in _JAVA_TOKENS:
+            raise ValueError(
+                f"unsupported date format token {tok!r} in {fmt!r}; "
+                f"supported: {sorted(_JAVA_TOKENS)}")
+    out = fmt
+    for j, p in _JAVA_TO_STRPTIME:
+        out = out.replace(j, p)
+    return out
+
+
+def _render_strptime(dt, pat: str) -> str:
+    """Zero-padded rendering of the supported strptime subset (glibc
+    strftime does not pad years < 1000, so it cannot be the canonical
+    form)."""
+    return (pat.replace("%Y", f"{dt.year:04d}")
+            .replace("%m", f"{dt.month:02d}")
+            .replace("%d", f"{dt.day:02d}")
+            .replace("%H", f"{dt.hour:02d}")
+            .replace("%M", f"{dt.minute:02d}")
+            .replace("%S", f"{dt.second:02d}"))
+
+
+class ToDate(UnaryExpression):
+    """to_date(str[, fmt]) -> DATE; unparseable strings become NULL
+    (Spark GetDate/ParseToDate).  The default 'yyyy-MM-dd' format parses
+    on device (fixed-position digit extraction over the byte buffer);
+    other formats run on CPU via strptime."""
+
+    FMT = "yyyy-MM-dd"
+
+    def __init__(self, child: Expression, fmt: str = FMT):
+        self.fmt = str(fmt)
+        super().__init__(child)
+
+    def with_children(self, children):
+        return ToDate(children[0], self.fmt)
+
+    def _resolve_type(self):
+        self.dtype = T.DATE
+        self.nullable = True
+
+    def tpu_supported(self, conf):
+        if self.child.dtype is T.DATE:
+            return None
+        if not (self.child.dtype.is_string or
+                self.child.dtype is T.NULL):
+            return f"to_date over {self.child.dtype} runs on CPU"
+        if self.fmt != self.FMT:
+            return f"to_date format {self.fmt!r} runs on CPU"
+        return None
+
+    def tpu_eval(self, ctx) -> DevVal:
+        v = self.child.tpu_eval(ctx)
+        if v.dtype is T.DATE:
+            return v
+        if v.offsets is None:  # NULL-typed literal input
+            zeros = jnp.zeros(ctx.capacity, dtype=jnp.int32)
+            return DevVal(T.DATE, zeros,
+                          jnp.zeros(ctx.capacity, dtype=jnp.bool_))
+        nbytes = int(v.data.shape[0])
+        starts = v.offsets[:-1].astype(jnp.int32)
+        lens = (v.offsets[1:] - v.offsets[:-1]).astype(jnp.int32)
+        idx = jnp.clip(starts[:, None] +
+                       jnp.arange(10, dtype=jnp.int32)[None, :],
+                       0, max(nbytes - 1, 0))
+        ch = v.data[idx].astype(jnp.int32)          # [cap, 10]
+        digit = (ch >= 48) & (ch <= 57)
+        ok = (lens == 10)
+        for p in (0, 1, 2, 3, 5, 6, 8, 9):
+            ok = ok & digit[:, p]
+        ok = ok & (ch[:, 4] == 45) & (ch[:, 7] == 45)
+        d10 = ch - 48
+        y = (d10[:, 0] * 1000 + d10[:, 1] * 100 + d10[:, 2] * 10
+             + d10[:, 3])
+        m = d10[:, 5] * 10 + d10[:, 6]
+        d = d10[:, 8] * 10 + d10[:, 9]
+        ok = ok & (m >= 1) & (m <= 12) & (d >= 1) & (d <= 31)
+        days = days_from_civil(y, jnp.maximum(m, 1), jnp.maximum(d, 1),
+                               jnp)
+        # exact calendar check: Feb 30 etc. roll over in days_from_civil,
+        # so require the round trip to reproduce (y, m, d)
+        y2, m2, d2 = civil_from_days(days, jnp)
+        ok = ok & (y2 == y) & (m2 == m) & (d2 == d)
+        return DevVal(T.DATE, days.astype(jnp.int32), v.validity & ok)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        import datetime as _dt
+        v = self.child.cpu_eval(ctx)
+        if v.dtype is T.DATE:
+            return v
+        pat = _java_fmt_to_strptime(self.fmt)
+        n = len(v.values)
+        out = np.zeros(n, dtype=np.int32)
+        valid = np.array(v.validity, dtype=np.bool_).copy()
+        epoch = _dt.date(1970, 1, 1)
+        for i, s in enumerate(v.values):
+            if not valid[i]:
+                continue
+            try:
+                dt = _dt.datetime.strptime(str(s), pat)
+                if _render_strptime(dt, pat) != str(s):
+                    # strict parse: python strptime accepts unpadded
+                    # fields ('2001-3-16'); the device kernel (and this
+                    # oracle) require the canonical padded form
+                    valid[i] = False
+                    continue
+                out[i] = (dt.date() - epoch).days
+            except ValueError:
+                valid[i] = False
+        return CpuVal(T.DATE, out, valid)
+
+
+class DateFormat(UnaryExpression):
+    """date_format(date|timestamp, fmt) -> STRING (Spark DateFormatClass).
+    'yyyy-MM-dd' renders on device (digit synthesis, the FromUnixTime
+    machinery); other formats run on CPU via strftime."""
+
+    FMT = "yyyy-MM-dd"
+
+    def __init__(self, child: Expression, fmt: str = FMT):
+        self.fmt = str(fmt)
+        super().__init__(child)
+
+    def with_children(self, children):
+        return DateFormat(children[0], self.fmt)
+
+    def _resolve_type(self):
+        if self.child.dtype not in (T.DATE, T.TIMESTAMP, T.NULL):
+            raise TypeError(
+                f"date_format needs a date/timestamp input, "
+                f"got {self.child.dtype}")
+        self.dtype = T.STRING
+        self.nullable = self.child.nullable
+
+    def tpu_supported(self, conf):
+        if self.fmt != self.FMT:
+            return f"date_format {self.fmt!r} runs on CPU"
+        return None
+
+    def tpu_eval(self, ctx) -> DevVal:
+        v = self.child.tpu_eval(ctx)
+        cap = ctx.capacity
+        days = _days_of(v, jnp)
+        y, m, d = civil_from_days(days, jnp)
+
+        def dig(x, p):
+            return ((x // p) % 10 + 48).astype(jnp.uint8)
+
+        dash = jnp.full(cap, 45, jnp.uint8)
+        cols = [dig(y, 1000), dig(y, 100), dig(y, 10), dig(y, 1), dash,
+                dig(m, 10), dig(m, 1), dash, dig(d, 10), dig(d, 1)]
+        return _emit_fixed_width(cols, v.validity, ctx)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        import datetime as _dt
+        v = self.child.cpu_eval(ctx)
+        pat = _java_fmt_to_strptime(self.fmt)
+        out = np.empty(len(v.values), dtype=object)
+        for i, (x, ok) in enumerate(zip(v.values, v.validity)):
+            if not ok:
+                out[i] = ""
+                continue
+            if v.dtype is T.TIMESTAMP:
+                dt = _dt.datetime(1970, 1, 1) + \
+                    _dt.timedelta(microseconds=int(x))
+            else:
+                dt = _dt.datetime(1970, 1, 1) + \
+                    _dt.timedelta(days=int(x))
+            out[i] = _render_strptime(dt, pat)
+        return CpuVal(T.STRING, out, v.validity)

@@ -242,6 +242,18 @@ def initcap(c) -> Column:
     return _unary(InitCap, c)
 
 
+def to_date(c, fmt: str = "yyyy-MM-dd") -> Column:
+    from spark_rapids_tpu.exprs.datetime import ToDate
+    c = col(c) if isinstance(c, str) else c
+    return Column(ToDate(_to_expr(c), fmt))
+
+
+def date_format(c, fmt: str = "yyyy-MM-dd") -> Column:
+    from spark_rapids_tpu.exprs.datetime import DateFormat
+    c = col(c) if isinstance(c, str) else c
+    return Column(DateFormat(_to_expr(c), fmt))
+
+
 def weekday(c) -> Column:
     from spark_rapids_tpu.exprs.datetime import WeekDay
     return _unary(WeekDay, c)

@@ -737,6 +737,19 @@ def _build_function(name: str, args: List[Expression], star: bool,
         return D.UnixTimestamp(args[0])
     if name == "to_unix_timestamp":
         return D.ToUnixTimestamp(args[0])
+    if name == "to_date":
+        from spark_rapids_tpu.exprs.base import Literal as _L
+        if len(args) == 1:
+            return D.ToDate(args[0])
+        if len(args) == 2 and isinstance(args[1], _L):
+            return D.ToDate(args[0], str(args[1].value))
+        raise SyntaxError("to_date(expr[, fmt]) needs a literal format")
+    if name == "date_format":
+        from spark_rapids_tpu.exprs.base import Literal as _L
+        if len(args) != 2 or not isinstance(args[1], _L):
+            raise SyntaxError(
+                "date_format(expr, fmt) needs a literal format")
+        return D.DateFormat(args[0], str(args[1].value))
     if name == "from_unixtime":
         if len(args) > 1:
             return D.FromUnixTime(args[0], args[1].value)

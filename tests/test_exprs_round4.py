@@ -237,3 +237,65 @@ def test_hex_double_saturation():
     assert rows[1] == "8000000000000000"   # -inf -> Long.MIN
     assert rows[2] == "7FFFFFFFFFFFFFFF"   # out of range saturates
     assert rows[3] == "8000000000000000"
+
+
+def test_to_date_and_date_format():
+    data = {"s": (T.STRING, ["2001-03-16", "1970-01-01", "2026-12-31",
+                             "not a date", "2001-13-01", None,
+                             "2001-3-16"])}
+
+    def build(s):
+        s.register_view("t", s.create_dataframe(data, num_partitions=2))
+        return s.sql("SELECT to_date(s) AS d, "
+                     "date_format(to_date(s), 'yyyy-MM-dd') AS f FROM t")
+
+    assert_tpu_cpu_equal(build, ignore_order=False)
+    s = tpu_session()
+    df = s.create_dataframe(data, num_partitions=1)
+    rows = df.select(F.to_date("s").alias("d"),
+                     F.date_format(F.to_date("s")).alias("f")).collect()
+    import datetime as dt
+    assert rows[0][0] == dt.date(2001, 3, 16) or rows[0][0] == 11397
+    assert rows[0][1] == "2001-03-16"
+    assert rows[1][1] == "1970-01-01"
+    assert rows[3] == (None, None)    # unparseable -> NULL
+    assert rows[4] == (None, None)    # month 13 -> NULL
+    assert rows[5] == (None, None)
+    assert rows[6] == (None, None)    # non-padded needs a custom fmt
+
+
+def test_to_date_custom_format_cpu_fallback():
+    data = {"s": (T.STRING, ["03/16/2001", "12/31/1970", "bad"])}
+
+    def build(s):
+        s.register_view("t", s.create_dataframe(data, num_partitions=1))
+        return s.sql("SELECT to_date(s, 'MM/dd/yyyy') AS d FROM t")
+
+    assert_tpu_cpu_equal(build, ignore_order=False,
+                         expect_fallback="to_date")
+
+
+def test_to_date_invalid_calendar_dates_and_old_years():
+    data = {"s": (T.STRING, ["2021-02-30", "2021-04-31", "2020-02-29",
+                             "0999-12-31", "2021-02-29"])}
+
+    def build(s):
+        s.register_view("t", s.create_dataframe(data, num_partitions=1))
+        return s.sql("SELECT to_date(s) AS d FROM t")
+
+    assert_tpu_cpu_equal(build, ignore_order=False)
+    s = tpu_session()
+    rows = s.create_dataframe(data, num_partitions=1).select(
+        F.to_date("s").alias("d")).collect()
+    assert rows[0][0] is None          # Feb 30
+    assert rows[1][0] is None          # Apr 31
+    assert rows[2][0] is not None      # leap day 2020
+    assert rows[3][0] is not None      # year < 1000 stays valid
+    assert rows[4][0] is None          # 2021 not a leap year
+
+
+def test_date_format_rejects_unsupported_tokens():
+    s = tpu_session()
+    df = s.create_dataframe({"d": (T.DATE, [0])}, num_partitions=1)
+    with pytest.raises(ValueError):
+        df.select(F.date_format("d", "dd-MMM-yyyy").alias("x")).collect()
