@@ -9,7 +9,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from spark_rapids_tpu import types as T
-from spark_rapids_tpu.exprs.base import CpuVal, DevVal, Expression, UnaryExpression
+from spark_rapids_tpu.exprs.base import (
+    CpuVal, DevVal, Expression, Literal, UnaryExpression,
+)
 
 
 class MonotonicallyIncreasingID(Expression):
@@ -237,3 +239,191 @@ class ArraySize(UnaryExpression):
         for i, (arr, ok) in enumerate(zip(v.values, v.validity)):
             out[i] = len(arr) if ok and arr is not None else 0
         return CpuVal(T.INT, out, v.validity)
+
+
+def _array_rows(v):
+    """int32[n_elements]: owning row of each flat element slot (the
+    strings module's byte->row mapping, reused for array elements)."""
+    from spark_rapids_tpu.exprs.strings import rows_of_positions
+    return rows_of_positions(v.offsets, int(v.data.shape[0]))
+
+
+class ArrayContains(Expression):
+    """array_contains(arr, literal) -> BOOLEAN (GpuArrayContains role,
+    collectionOperations).  NULL array -> NULL; literal must be a
+    non-null scalar (Spark requires a foldable non-null value)."""
+
+    def __init__(self, child: Expression, value):
+        if isinstance(value, Expression) and not isinstance(value,
+                                                            Literal):
+            raise NotImplementedError(
+                "array_contains needs a literal needle (column-valued "
+                "needles are not supported, like the reference's GPU "
+                "plugin)")
+        if not isinstance(value, Literal):
+            value = Literal(value)
+        if value.value is None:
+            raise ValueError("array_contains value must not be NULL")
+        self.children = (child, value)
+        self.dtype = T.BOOLEAN
+        # NULL when the array row is NULL, or when it has NULL elements
+        # and no match (Spark three-valued IN semantics)
+        self.nullable = True
+
+    def with_children(self, children):
+        return ArrayContains(children[0], children[1])
+
+    def _check_needle(self, elem_dt):
+        v = self.children[1].value
+        if elem_dt.is_string:
+            ok = isinstance(v, str)
+        elif elem_dt == T.BOOLEAN:
+            ok = isinstance(v, bool)
+        elif elem_dt.is_integral:
+            ok = isinstance(v, int) and not isinstance(v, bool)
+        else:  # fractional: int or float needle compares numerically
+            ok = isinstance(v, (int, float)) and not isinstance(v, bool)
+        if not ok:
+            raise TypeError(
+                f"array_contains needle {v!r} does not match element "
+                f"type {elem_dt} (no implicit narrowing)")
+
+    def tpu_supported(self, conf):
+        dt = self.children[0].dtype
+        if not isinstance(dt, T.ArrayType):
+            return f"array_contains needs an array, got {dt}"
+        if dt.element.is_string:
+            return "array<string> is host-only"
+        self._check_needle(dt.element)
+        return None
+
+    def tpu_eval(self, ctx) -> DevVal:
+        import jax
+        import jax.numpy as jnp
+        v = self.children[0].tpu_eval(ctx)
+        cap = ctx.capacity
+        elem_dt = self.children[0].dtype.element
+        self._check_needle(elem_dt)
+        needle = jnp.asarray(self.children[1].value,
+                             dtype=elem_dt.jnp_dtype)
+        rows = jnp.clip(_array_rows(v), 0, cap - 1)
+        nelem = int(v.data.shape[0])
+        in_range = jnp.arange(nelem, dtype=jnp.int32) < v.offsets[-1]
+        hit = in_range & (v.data == needle)
+        n_hits = jax.ops.segment_sum(hit.astype(jnp.int32), rows,
+                                     num_segments=cap,
+                                     indices_are_sorted=True)
+        return DevVal(T.BOOLEAN, n_hits > 0, v.validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        v = self.children[0].cpu_eval(ctx)
+        dt = self.children[0].dtype
+        if isinstance(dt, T.ArrayType):
+            self._check_needle(dt.element)
+        needle = self.children[1].value
+        n = len(v.values)
+        out = np.zeros(n, dtype=np.bool_)
+        valid = np.array(v.validity, dtype=np.bool_).copy()
+        for i, (arr, ok) in enumerate(zip(v.values, v.validity)):
+            if not (ok and arr is not None):
+                continue
+            hit = any(e is not None and e == needle for e in arr)
+            out[i] = hit
+            if not hit and any(e is None for e in arr):
+                valid[i] = False  # Spark: NULL element + no match -> NULL
+        return CpuVal(T.BOOLEAN, out, valid)
+
+
+class _ArrayMinMax(UnaryExpression):
+    """array_min / array_max: reduce each row's elements (NULL for an
+    empty or NULL array, Spark semantics)."""
+
+    _is_min = True
+
+    def _resolve_type(self):
+        dt = self.child.dtype
+        self.dtype = dt.element if isinstance(dt, T.ArrayType) else T.NULL
+        self.nullable = True
+
+    def tpu_supported(self, conf):
+        dt = self.child.dtype
+        if not isinstance(dt, T.ArrayType):
+            return f"{self.name} needs an array, got {dt}"
+        if dt.element.is_string:
+            return "array<string> is host-only"
+        return None
+
+    def tpu_eval(self, ctx) -> DevVal:
+        import jax
+        import jax.numpy as jnp
+        v = self.child.tpu_eval(ctx)
+        cap = ctx.capacity
+        jdt = self.dtype.jnp_dtype
+        if self.dtype.is_fractional:
+            ident = jnp.asarray(jnp.inf if self._is_min else -jnp.inf,
+                                jdt)
+        elif self.dtype == T.BOOLEAN:
+            ident = jnp.asarray(True if self._is_min else False)
+        else:
+            info = jnp.iinfo(jdt)
+            ident = jnp.asarray(info.max if self._is_min else info.min,
+                                jdt)
+        rows = jnp.clip(_array_rows(v), 0, cap - 1)
+        nelem = int(v.data.shape[0])
+        in_range = jnp.arange(nelem, dtype=jnp.int32) < v.offsets[-1]
+        x = jnp.where(in_range, v.data.astype(jdt), ident)
+        if self.dtype.is_fractional:
+            # Spark orders NaN as the LARGEST value: min skips NaNs
+            # (unless every element is NaN), max is NaN if any present
+            is_nan = in_range & jnp.isnan(x)
+            x = jnp.where(is_nan, ident, x)
+            nan_cnt = jax.ops.segment_sum(
+                is_nan.astype(jnp.int32), rows, num_segments=cap,
+                indices_are_sorted=True)
+            notnan_cnt = jax.ops.segment_sum(
+                (in_range & ~is_nan).astype(jnp.int32), rows,
+                num_segments=cap, indices_are_sorted=True)
+        red = jax.ops.segment_min if self._is_min else \
+            jax.ops.segment_max
+        out = red(x, rows, num_segments=cap, indices_are_sorted=True)
+        if self.dtype.is_fractional:
+            nan = jnp.asarray(jnp.nan, jdt)
+            if self._is_min:
+                out = jnp.where((notnan_cnt == 0) & (nan_cnt > 0), nan,
+                                out)
+            else:
+                out = jnp.where(nan_cnt > 0, nan, out)
+        lens = (v.offsets[1:] - v.offsets[:-1]) > 0
+        return DevVal(self.dtype, out, v.validity & lens)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        v = self.child.cpu_eval(ctx)
+        n = len(v.values)
+        out = np.zeros(n, dtype=self.dtype.np_dtype)
+        valid = np.zeros(n, dtype=np.bool_)
+        frac = self.dtype.is_fractional
+        for i, (arr, ok) in enumerate(zip(v.values, v.validity)):
+            if not (ok and arr):
+                continue
+            vals = [e for e in arr if e is not None]
+            if not vals:
+                continue
+            valid[i] = True
+            if frac:
+                nn = [e for e in vals if e == e]
+                if self._is_min:
+                    out[i] = min(nn) if nn else float("nan")
+                else:
+                    out[i] = float("nan") if len(nn) < len(vals) \
+                        else max(vals)
+            else:
+                out[i] = min(vals) if self._is_min else max(vals)
+        return CpuVal(self.dtype, out, valid)
+
+
+class ArrayMin(_ArrayMinMax):
+    _is_min = True
+
+
+class ArrayMax(_ArrayMinMax):
+    _is_min = False

@@ -420,6 +420,22 @@ def array(*cols) -> Column:
         col(c) if isinstance(c, str) else c) for c in cols]))
 
 
+def array_contains(c, value) -> Column:
+    from spark_rapids_tpu.exprs.misc import ArrayContains
+    c = col(c) if isinstance(c, str) else c
+    return Column(ArrayContains(_to_expr(c), value))
+
+
+def array_min(c) -> Column:
+    from spark_rapids_tpu.exprs.misc import ArrayMin
+    return _unary(ArrayMin, c)
+
+
+def array_max(c) -> Column:
+    from spark_rapids_tpu.exprs.misc import ArrayMax
+    return _unary(ArrayMax, c)
+
+
 def monotonically_increasing_id() -> Column:
     from spark_rapids_tpu.exprs.misc import MonotonicallyIncreasingID
     return Column(MonotonicallyIncreasingID())

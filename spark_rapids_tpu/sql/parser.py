@@ -764,6 +764,15 @@ def _build_function(name: str, args: List[Expression], star: bool,
     if name == "size":
         from spark_rapids_tpu.exprs.misc import ArraySize
         return ArraySize(args[0])
+    if name == "array_contains":
+        from spark_rapids_tpu.exprs.misc import ArrayContains
+        return ArrayContains(args[0], args[1])
+    if name == "array_min":
+        from spark_rapids_tpu.exprs.misc import ArrayMin
+        return ArrayMin(args[0])
+    if name == "array_max":
+        from spark_rapids_tpu.exprs.misc import ArrayMax
+        return ArrayMax(args[0])
     if name == "array":
         from spark_rapids_tpu.exprs.misc import CreateArray
         return CreateArray(*args)

@@ -117,3 +117,79 @@ def test_get_item_negative_ordinal_is_null():
     assert_tpu_cpu_equal(q)
     s = tpu_session()
     assert all(r[1] is None for r in q(s).collect())
+
+
+class TestArrayFunctions:
+    DATA = {"g": (T.STRING, ["a", "b", "c", "d"]),
+            "arr": (T.ArrayType(T.INT),
+                    [[1, 5, 3], [7], [], None])}
+
+    def test_array_contains_min_max(self):
+        def build(s):
+            df = s.create_dataframe(self.DATA, num_partitions=2)
+            return df.select(
+                df["g"],
+                F.array_contains(df["arr"], 5).alias("has5"),
+                F.array_min("arr").alias("mn"),
+                F.array_max("arr").alias("mx")).order_by("g")
+
+        assert_tpu_cpu_equal(build, ignore_order=False)
+        from compare import tpu_session
+        s = tpu_session()
+        df = s.create_dataframe(self.DATA, num_partitions=1)
+        rows = df.select(
+            F.array_contains(df["arr"], 5).alias("h"),
+            F.array_min("arr").alias("mn"),
+            F.array_max("arr").alias("mx")).collect()
+        assert rows[0] == (True, 1, 5)
+        assert rows[1] == (False, 7, 7)
+        assert rows[2] == (False, None, None)   # empty array
+        assert rows[3] == (None, None, None)    # NULL array
+
+    def test_array_functions_sql(self):
+        def build(s):
+            s.register_view("t", s.create_dataframe(self.DATA,
+                                                    num_partitions=2))
+            return s.sql(
+                "SELECT g, array_contains(arr, 3) AS h, "
+                "array_min(arr) AS mn, array_max(arr) AS mx "
+                "FROM t ORDER BY g")
+
+        assert_tpu_cpu_equal(build, ignore_order=False)
+
+    def test_array_contains_rejects_null_needle(self):
+        from compare import tpu_session
+        s = tpu_session()
+        df = s.create_dataframe(self.DATA, num_partitions=1)
+        with pytest.raises(ValueError):
+            F.array_contains(df["arr"], None)
+
+    def test_array_min_max_nan_ordering(self):
+        data = {"arr": (T.ArrayType(T.DOUBLE),
+                        [[1.0, float("nan")], [float("nan")],
+                         [2.0, 3.0]])}
+
+        def build(s):
+            df = s.create_dataframe(data, num_partitions=2)
+            return df.select(F.array_min("arr").alias("mn"),
+                             F.array_max("arr").alias("mx"))
+
+        assert_tpu_cpu_equal(build, approx=True, ignore_order=False)
+        from compare import tpu_session
+        s = tpu_session()
+        rows = s.create_dataframe(data, num_partitions=1).select(
+            F.array_min("arr").alias("mn"),
+            F.array_max("arr").alias("mx")).collect()
+        import math
+        # Spark: NaN is the largest value
+        assert rows[0][0] == 1.0 and math.isnan(rows[0][1])
+        assert math.isnan(rows[1][0]) and math.isnan(rows[1][1])
+        assert rows[2] == (2.0, 3.0)
+
+    def test_array_contains_type_mismatch_rejected(self):
+        from compare import tpu_session
+        s = tpu_session()
+        df = s.create_dataframe(self.DATA, num_partitions=1)
+        with pytest.raises(TypeError):
+            df.select(F.array_contains(df["arr"], 2.5).alias("h")) \
+                .collect()
