@@ -113,13 +113,13 @@ def register_mortgage(session, sf: float = 0.1, num_partitions: int = 3):
 
 
 def _perf_prepared(perf):
-    """Date decomposition (CreatePerformanceDelinquency.prepare)."""
+    """Date decomposition (CreatePerformanceDelinquency.prepare, which
+    runs to_date + year/month/dayofmonth over the period string)."""
     from spark_rapids_tpu import functions as F
-    ym = F.split_part(perf["monthly_reporting_period"], "-", 1)
-    mm = F.split_part(perf["monthly_reporting_period"], "-", 2)
+    d = F.to_date(perf["monthly_reporting_period"])
     return (perf
-            .with_column("timestamp_year", ym.cast(T.INT))
-            .with_column("timestamp_month", mm.cast(T.INT)))
+            .with_column("timestamp_year", F.year(d))
+            .with_column("timestamp_month", F.month(d)))
 
 
 def delinquency_frame(perf):
