@@ -248,6 +248,32 @@ def _array_rows(v):
     return rows_of_positions(v.offsets, int(v.data.shape[0]))
 
 
+def _element_slots(v, cap):
+    """(rows, in_range) for the flat element buffer: owning row per slot
+    (clipped into [0, cap)) and the live-slot mask."""
+    nelem = int(v.data.shape[0])
+    rows = jnp.clip(_array_rows(v), 0, cap - 1)
+    in_range = jnp.arange(nelem, dtype=jnp.int32) < v.offsets[-1]
+    return rows, in_range
+
+
+def _check_array_needle(elem_dt, value):
+    """Reject needles whose python type does not match the element type
+    (a silent narrowing cast would diverge between backends)."""
+    if elem_dt.is_string:
+        ok = isinstance(value, str)
+    elif elem_dt == T.BOOLEAN:
+        ok = isinstance(value, bool)
+    elif elem_dt.is_integral:
+        ok = isinstance(value, int) and not isinstance(value, bool)
+    else:
+        ok = isinstance(value, (int, float)) and             not isinstance(value, bool)
+    if not ok:
+        raise TypeError(
+            f"needle {value!r} does not match array element type "
+            f"{elem_dt} (no implicit narrowing)")
+
+
 class ArrayContains(Expression):
     """array_contains(arr, literal) -> BOOLEAN (GpuArrayContains role,
     collectionOperations).  NULL array -> NULL; literal must be a
@@ -274,19 +300,7 @@ class ArrayContains(Expression):
         return ArrayContains(children[0], children[1])
 
     def _check_needle(self, elem_dt):
-        v = self.children[1].value
-        if elem_dt.is_string:
-            ok = isinstance(v, str)
-        elif elem_dt == T.BOOLEAN:
-            ok = isinstance(v, bool)
-        elif elem_dt.is_integral:
-            ok = isinstance(v, int) and not isinstance(v, bool)
-        else:  # fractional: int or float needle compares numerically
-            ok = isinstance(v, (int, float)) and not isinstance(v, bool)
-        if not ok:
-            raise TypeError(
-                f"array_contains needle {v!r} does not match element "
-                f"type {elem_dt} (no implicit narrowing)")
+        _check_array_needle(elem_dt, self.children[1].value)
 
     def tpu_supported(self, conf):
         dt = self.children[0].dtype
@@ -306,9 +320,7 @@ class ArrayContains(Expression):
         self._check_needle(elem_dt)
         needle = jnp.asarray(self.children[1].value,
                              dtype=elem_dt.jnp_dtype)
-        rows = jnp.clip(_array_rows(v), 0, cap - 1)
-        nelem = int(v.data.shape[0])
-        in_range = jnp.arange(nelem, dtype=jnp.int32) < v.offsets[-1]
+        rows, in_range = _element_slots(v, cap)
         hit = in_range & (v.data == needle)
         n_hits = jax.ops.segment_sum(hit.astype(jnp.int32), rows,
                                      num_segments=cap,
@@ -368,9 +380,7 @@ class _ArrayMinMax(UnaryExpression):
             info = jnp.iinfo(jdt)
             ident = jnp.asarray(info.max if self._is_min else info.min,
                                 jdt)
-        rows = jnp.clip(_array_rows(v), 0, cap - 1)
-        nelem = int(v.data.shape[0])
-        in_range = jnp.arange(nelem, dtype=jnp.int32) < v.offsets[-1]
+        rows, in_range = _element_slots(v, cap)
         x = jnp.where(in_range, v.data.astype(jdt), ident)
         if self.dtype.is_fractional:
             # Spark orders NaN as the LARGEST value: min skips NaNs
@@ -427,3 +437,140 @@ class ArrayMin(_ArrayMinMax):
 
 class ArrayMax(_ArrayMinMax):
     _is_min = False
+
+
+class SortArray(UnaryExpression):
+    """sort_array(arr[, asc]) — per-row element sort (Spark SortArray).
+    Device path: one lexsort over (owning row, element value) reorders
+    the flat element buffer; offsets/validity are untouched."""
+
+    def __init__(self, child: Expression, ascending: bool = True):
+        self.ascending = bool(ascending)
+        super().__init__(child)
+
+    def with_children(self, children):
+        return SortArray(children[0], self.ascending)
+
+    def _resolve_type(self):
+        self.dtype = self.child.dtype
+        self.nullable = self.child.nullable
+
+    def tpu_supported(self, conf):
+        dt = self.child.dtype
+        if not isinstance(dt, T.ArrayType):
+            return f"sort_array needs an array, got {dt}"
+        if dt.element.is_string:
+            return "array<string> is host-only"
+        return None
+
+    def tpu_eval(self, ctx) -> DevVal:
+        import jax.numpy as jnp
+        v = self.child.tpu_eval(ctx)
+        cap = ctx.capacity
+        rows, in_range = _element_slots(v, cap)
+        elem_dt = self.child.dtype.element
+        jdt = elem_dt.jnp_dtype
+        x = v.data.astype(jdt)
+        if elem_dt.is_fractional:
+            is_nan = jnp.isnan(x)
+            rk = jnp.where(is_nan, jnp.inf, x.astype(jnp.float64))
+            if not self.ascending:
+                rk = -rk
+            # rank separates NaN from real infinities on key ties, and
+            # padding from everything: NaN sorts last ascending / first
+            # descending (Spark: NaN is the largest value)
+            nan_rank = jnp.where(is_nan,
+                                 1 if self.ascending else -1, 0)
+        else:
+            rk = x.astype(jnp.int64)  # exact for the full int64 range
+            if not self.ascending:
+                rk = ~rk  # complement: monotone flip, no INT64_MIN wrap
+            nan_rank = jnp.zeros_like(rows)
+        rk = jnp.where(in_range, rk, 0)
+        nan_rank = jnp.where(in_range, nan_rank, 2)  # padding dead last
+        order = jnp.lexsort((nan_rank, rk, rows.astype(jnp.int32)))
+        data = v.data[order]
+        return DevVal(self.dtype, data, v.validity, v.offsets)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        v = self.child.cpu_eval(ctx)
+        out = np.empty(len(v.values), dtype=object)
+        for i, (arr, ok) in enumerate(zip(v.values, v.validity)):
+            if not ok or arr is None:
+                out[i] = None
+                continue
+            nn = [e for e in arr if e is not None]
+            nulls = [None] * (len(arr) - len(nn))
+            key = (lambda e: (e != e, e)) if any(
+                isinstance(e, float) for e in nn) else (lambda e: e)
+            s = sorted(nn, key=key, reverse=not self.ascending)
+            # Spark: NULL elements first ascending, last descending
+            out[i] = nulls + s if self.ascending else s + nulls
+        return CpuVal(self.dtype, out, v.validity)
+
+
+class ArrayPosition(Expression):
+    """array_position(arr, literal): 1-based index of the first match,
+    0 when absent, NULL for a NULL array (Spark ArrayPosition)."""
+
+    def __init__(self, child: Expression, value):
+        if isinstance(value, Expression) and not isinstance(value,
+                                                            Literal):
+            raise NotImplementedError(
+                "array_position needs a literal needle")
+        if not isinstance(value, Literal):
+            value = Literal(value)
+        if value.value is None:
+            raise ValueError("array_position value must not be NULL")
+        self.children = (child, value)
+        self.dtype = T.LONG
+        self.nullable = child.nullable
+
+    def with_children(self, children):
+        return ArrayPosition(children[0], children[1])
+
+    def tpu_supported(self, conf):
+        dt = self.children[0].dtype
+        if not isinstance(dt, T.ArrayType):
+            return f"array_position needs an array, got {dt}"
+        if dt.element.is_string:
+            return "array<string> is host-only"
+        _check_array_needle(dt.element, self.children[1].value)
+        return None
+
+    def tpu_eval(self, ctx) -> DevVal:
+        import jax
+        import jax.numpy as jnp
+        v = self.children[0].tpu_eval(ctx)
+        cap = ctx.capacity
+        elem_dt = self.children[0].dtype.element
+        _check_array_needle(elem_dt, self.children[1].value)
+        needle = jnp.asarray(self.children[1].value,
+                             dtype=elem_dt.jnp_dtype)
+        rows, in_range = _element_slots(v, cap)
+        pos = jnp.arange(int(v.data.shape[0]), dtype=jnp.int32)
+        hit = in_range & (v.data == needle)
+        big = jnp.int32(1 << 30)
+        first = jax.ops.segment_min(jnp.where(hit, pos, big), rows,
+                                    num_segments=cap,
+                                    indices_are_sorted=True)
+        found = first < big
+        idx = jnp.where(found,
+                        first - v.offsets[:-1].astype(jnp.int32) + 1, 0)
+        return DevVal(T.LONG, idx.astype(jnp.int64), v.validity)
+
+    def cpu_eval(self, ctx) -> CpuVal:
+        v = self.children[0].cpu_eval(ctx)
+        dt = self.children[0].dtype
+        if isinstance(dt, T.ArrayType):
+            _check_array_needle(dt.element, self.children[1].value)
+        needle = self.children[1].value
+        n = len(v.values)
+        out = np.zeros(n, dtype=np.int64)
+        for i, (arr, ok) in enumerate(zip(v.values, v.validity)):
+            if ok and arr is not None:
+                for j, e in enumerate(arr):
+                    if e is not None and e == needle:
+                        out[i] = j + 1
+                        break
+        return CpuVal(T.LONG, out, v.validity)

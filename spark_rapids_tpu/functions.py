@@ -436,6 +436,18 @@ def array_max(c) -> Column:
     return _unary(ArrayMax, c)
 
 
+def sort_array(c, asc: bool = True) -> Column:
+    from spark_rapids_tpu.exprs.misc import SortArray
+    c = col(c) if isinstance(c, str) else c
+    return Column(SortArray(_to_expr(c), asc))
+
+
+def array_position(c, value) -> Column:
+    from spark_rapids_tpu.exprs.misc import ArrayPosition
+    c = col(c) if isinstance(c, str) else c
+    return Column(ArrayPosition(_to_expr(c), value))
+
+
 def monotonically_increasing_id() -> Column:
     from spark_rapids_tpu.exprs.misc import MonotonicallyIncreasingID
     return Column(MonotonicallyIncreasingID())

@@ -773,6 +773,19 @@ def _build_function(name: str, args: List[Expression], star: bool,
     if name == "array_max":
         from spark_rapids_tpu.exprs.misc import ArrayMax
         return ArrayMax(args[0])
+    if name == "sort_array":
+        from spark_rapids_tpu.exprs.base import Literal as _L
+        from spark_rapids_tpu.exprs.misc import SortArray
+        asc = True
+        if len(args) == 2:
+            if not isinstance(args[1], _L):
+                raise SyntaxError(
+                    "sort_array(arr, asc) needs a literal boolean")
+            asc = bool(args[1].value)
+        return SortArray(args[0], asc)
+    if name == "array_position":
+        from spark_rapids_tpu.exprs.misc import ArrayPosition
+        return ArrayPosition(args[0], args[1])
     if name == "array":
         from spark_rapids_tpu.exprs.misc import CreateArray
         return CreateArray(*args)

@@ -193,3 +193,74 @@ class TestArrayFunctions:
         with pytest.raises(TypeError):
             df.select(F.array_contains(df["arr"], 2.5).alias("h")) \
                 .collect()
+
+    def test_sort_array_and_position(self):
+        data = {"arr": (T.ArrayType(T.INT),
+                        [[3, 1, 2], [5], [], None, [9, 9, 1]])}
+
+        def build(s):
+            df = s.create_dataframe(data, num_partitions=2)
+            return df.select(
+                F.sort_array("arr").alias("sa"),
+                F.sort_array("arr", asc=False).alias("sd"),
+                F.array_position(df["arr"], 9).alias("p9"))
+
+        assert_tpu_cpu_equal(build, ignore_order=False)
+        from compare import tpu_session
+        s = tpu_session()
+        rows = s.create_dataframe(data, num_partitions=1).select(
+            F.sort_array("arr").alias("sa"),
+            F.sort_array("arr", asc=False).alias("sd"),
+            F.array_position(F.col("arr"), 1).alias("p1")).collect()
+        assert rows[0] == ([1, 2, 3], [3, 2, 1], 2)
+        assert rows[1] == ([5], [5], 0)
+        assert rows[2] == ([], [], 0)
+        assert rows[3] == (None, None, None)
+        assert rows[4][0] == [1, 9, 9] and rows[4][2] == 3
+
+    def test_sort_array_nan_and_sql(self):
+        data = {"arr": (T.ArrayType(T.DOUBLE),
+                        [[2.0, float("nan"), 1.0]])}
+
+        def build(s):
+            s.register_view("t", s.create_dataframe(data,
+                                                    num_partitions=1))
+            return s.sql("SELECT sort_array(arr) AS sa, "
+                         "sort_array(arr, false) AS sd, "
+                         "array_position(arr, 2.0) AS p FROM t")
+
+        assert_tpu_cpu_equal(build, approx=True, ignore_order=False)
+        from compare import tpu_session
+        s = tpu_session()
+        s.register_view("t", s.create_dataframe(data, num_partitions=1))
+        row = s.sql("SELECT sort_array(arr) AS sa FROM t").collect()[0]
+        import math
+        assert row[0][0] == 1.0 and row[0][1] == 2.0 \
+            and math.isnan(row[0][2])  # NaN sorts largest
+
+    def test_sort_array_nan_vs_inf_and_int_extremes(self):
+        data = {"f": (T.ArrayType(T.DOUBLE),
+                      [[float("nan"), float("inf"), 1.0]]),
+                "l": (T.ArrayType(T.LONG),
+                      [[-9223372036854775808, 0, 5]])}
+
+        def build(s):
+            df = s.create_dataframe(data, num_partitions=1)
+            return df.select(
+                F.sort_array("f").alias("fa"),
+                F.sort_array("f", asc=False).alias("fd"),
+                F.sort_array("l", asc=False).alias("ld"))
+
+        assert_tpu_cpu_equal(build, approx=True, ignore_order=False)
+        from compare import tpu_session
+        import math
+        s = tpu_session()
+        row = s.create_dataframe(data, num_partitions=1).select(
+            F.sort_array("f").alias("fa"),
+            F.sort_array("f", asc=False).alias("fd"),
+            F.sort_array("l", asc=False).alias("ld")).collect()[0]
+        fa, fd, ld = row
+        assert fa[0] == 1.0 and fa[1] == float("inf") \
+            and math.isnan(fa[2])            # NaN strictly after +inf
+        assert math.isnan(fd[0]) and fd[1] == float("inf")
+        assert ld == [5, 0, -9223372036854775808]  # no INT64_MIN wrap
