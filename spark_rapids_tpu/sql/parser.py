@@ -27,6 +27,23 @@ from spark_rapids_tpu.exprs.base import (
 from spark_rapids_tpu.sql.lexer import Token, tokenize
 
 
+class _GeneratorCall(Expression):
+    """Marker for explode()/posexplode() in a SELECT list: build_select
+    rewrites the source through DataFrame.explode before projecting
+    (Spark's single-generator-per-select rule)."""
+
+    def __init__(self, column: str, pos: bool, outer: bool):
+        self.column = column
+        self.pos = pos
+        self.outer = outer
+        self.children = ()
+        self.dtype = T.NULL
+        self.nullable = True
+
+    def with_children(self, children):
+        return self
+
+
 class Parser:
     def __init__(self, tokens: List[Token], session):
         self.toks = tokens
@@ -225,8 +242,57 @@ class Parser:
                      distinct, group_sets=None):
         from spark_rapids_tpu.dataframe import Column
         from spark_rapids_tpu.exprs.base import output_name, resolve
+        def _has_gen(e):
+            if isinstance(e, _GeneratorCall):
+                return True
+            return any(_has_gen(c) for c in e.children)
+
+        for clause in ([where] if where is not None else []) \
+                + (group_by or []) \
+                + ([having] if having is not None else []):
+            if _has_gen(clause):
+                raise SyntaxError(
+                    "explode/posexplode is only allowed as a top-level "
+                    "SELECT expression")
+        gens = [(i, e, nm) for i, (e, nm) in enumerate(projections)
+                if isinstance(e, _GeneratorCall)]
+        for e, _nm in projections:
+            if not isinstance(e, _GeneratorCall) and _has_gen(e):
+                raise SyntaxError(
+                    "explode/posexplode cannot be nested inside another "
+                    "expression")
+        if len(gens) > 1:
+            raise SyntaxError(
+                "only one generator (explode/posexplode) per SELECT")
+        if gens and star:
+            raise SyntaxError(
+                "SELECT * with a generator is not supported; list the "
+                "columns explicitly (the engine's explode replaces the "
+                "source array column)")
+        # WHERE runs pre-projection, so filter BEFORE exploding (the
+        # predicate may reference the array column Generate drops)
         if where is not None:
             df = df.filter(Column(where))
+            where = None
+        if gens:
+            i, g, nm = gens[0]
+            alias = nm or "col"
+            if g.pos and "pos" in df.schema:
+                raise SyntaxError(
+                    "posexplode output column 'pos' collides with an "
+                    "existing column; rename it first")
+            df = df.explode(g.column, alias=alias, pos=g.pos,
+                            outer=g.outer)
+            if g.pos:
+                # posexplode emits (pos, col); surface both columns
+                projections = (projections[:i]
+                               + [(ColumnRef("pos"), "pos"),
+                                  (ColumnRef(alias), alias)]
+                               + projections[i + 1:])
+            else:
+                projections = (projections[:i]
+                               + [(ColumnRef(alias), alias)]
+                               + projections[i + 1:])
         has_agg = group_by is not None or any(
             _contains_agg(e) for e, _ in projections) or \
             (having is not None and _contains_agg(having))
@@ -786,6 +852,12 @@ def _build_function(name: str, args: List[Expression], star: bool,
     if name == "array_position":
         from spark_rapids_tpu.exprs.misc import ArrayPosition
         return ArrayPosition(args[0], args[1])
+    if name in ("explode", "explode_outer", "posexplode"):
+        if len(args) != 1 or not isinstance(args[0], ColumnRef):
+            raise SyntaxError(
+                f"{name}() takes exactly one plain column argument")
+        return _GeneratorCall(args[0].column, name == "posexplode",
+                              name == "explode_outer")
     if name == "array":
         from spark_rapids_tpu.exprs.misc import CreateArray
         return CreateArray(*args)

@@ -264,3 +264,59 @@ class TestArrayFunctions:
             and math.isnan(fa[2])            # NaN strictly after +inf
         assert math.isnan(fd[0]) and fd[1] == float("inf")
         assert ld == [5, 0, -9223372036854775808]  # no INT64_MIN wrap
+
+    def test_sql_explode_in_select(self):
+        data = {"g": (T.STRING, ["a", "b", "c"]),
+                "arr": (T.ArrayType(T.INT), [[1, 2], [3], []])}
+
+        def build(s):
+            s.register_view("t", s.create_dataframe(data,
+                                                    num_partitions=2))
+            return s.sql("SELECT g, explode(arr) AS e FROM t "
+                         "ORDER BY g, e")
+
+        assert_tpu_cpu_equal(build, ignore_order=False)
+        from compare import tpu_session
+        s = tpu_session()
+        s.register_view("t", s.create_dataframe(data, num_partitions=1))
+        rows = s.sql("SELECT g, explode(arr) AS e FROM t "
+                     "ORDER BY g, e").collect()
+        assert rows == [("a", 1), ("a", 2), ("b", 3)]  # empty drops
+        rows = s.sql("SELECT g, pos, e FROM "
+                     "(SELECT g, posexplode(arr) AS e FROM t) "
+                     "ORDER BY g, pos").collect()
+        assert rows == [("a", 0, 1), ("a", 1, 2), ("b", 0, 3)]
+
+    def test_sql_explode_restrictions(self):
+        from compare import tpu_session
+        s = tpu_session()
+        s.register_view("t", s.create_dataframe(
+            {"a": (T.ArrayType(T.INT), [[1]]),
+             "b": (T.ArrayType(T.INT), [[2]])}, num_partitions=1))
+        with pytest.raises(SyntaxError):
+            s.sql("SELECT explode(a) AS x, explode(b) AS y FROM t")
+
+    def test_sql_explode_with_where_and_guards(self):
+        data = {"g": (T.STRING, ["a", "b"]),
+                "arr": (T.ArrayType(T.INT), [[1, 2], [3]])}
+
+        def build(s):
+            s.register_view("t", s.create_dataframe(data,
+                                                    num_partitions=1))
+            # WHERE references the array column the explode consumes
+            return s.sql("SELECT g, explode(arr) AS e FROM t "
+                         "WHERE size(arr) > 1 ORDER BY g, e")
+
+        assert_tpu_cpu_equal(build, ignore_order=False)
+        from compare import tpu_session
+        s = tpu_session()
+        s.register_view("t", s.create_dataframe(data, num_partitions=1))
+        rows = s.sql("SELECT g, explode(arr) AS e FROM t "
+                     "WHERE size(arr) > 1 ORDER BY g, e").collect()
+        assert rows == [("a", 1), ("a", 2)]
+        with pytest.raises(SyntaxError):
+            s.sql("SELECT explode(arr) + 1 AS x FROM t")
+        with pytest.raises(SyntaxError):
+            s.sql("SELECT *, explode(arr) AS e FROM t")
+        with pytest.raises(SyntaxError):
+            s.sql("SELECT g FROM t WHERE explode(arr) > 1")
