@@ -97,6 +97,10 @@ class _Gen:
             return F.floor(a)
         if kind == 8:
             return F.length(self.string(depth - 1)).cast(T.DOUBLE)
+        if self.r.rand() < 0.5:
+            # round-4 date parts over the date column
+            part = self.pick([F.weekday, F.year, F.month])
+            return part(self.df["dt"]).cast(T.DOUBLE)
         return -a
 
     def boolean(self, depth):
@@ -134,6 +138,11 @@ class _Gen:
                             self.string(depth - 1))
         if kind == 4:
             return F.trim(self.string(depth - 1))
+        if self.r.rand() < 0.4:
+            return F.initcap(self.string(depth - 1))
+        if self.r.rand() < 0.4:
+            return F.substring_index(self.string(depth - 1), "-",
+                                     int(self.r.randint(1, 3)))
         return F.when(self.boolean(depth - 1),
                       self.string(depth - 1)).otherwise(
             self.string(depth - 1))
