@@ -67,7 +67,7 @@ class _Gen:
             return self.df[self.pick(self.NUM_COLS)]
         a = self.numeric(depth - 1)
         b = self.numeric(depth - 1)
-        kind = self.r.randint(0, 10)
+        kind = self.r.randint(0, 11)
         if kind == 0:
             return a + b
         if kind == 1:
@@ -97,7 +97,7 @@ class _Gen:
             return F.floor(a)
         if kind == 8:
             return F.length(self.string(depth - 1)).cast(T.DOUBLE)
-        if self.r.rand() < 0.5:
+        if kind == 9:
             # round-4 date parts over the date column
             part = self.pick([F.weekday, F.year, F.month])
             return part(self.df["dt"]).cast(T.DOUBLE)
@@ -124,7 +124,7 @@ class _Gen:
     def string(self, depth):
         if depth <= 0:
             return self.df["s"]
-        kind = self.r.randint(0, 6)
+        kind = self.r.randint(0, 8)
         if kind == 0:
             return F.upper(self.string(depth - 1))
         if kind == 1:
@@ -138,9 +138,9 @@ class _Gen:
                             self.string(depth - 1))
         if kind == 4:
             return F.trim(self.string(depth - 1))
-        if self.r.rand() < 0.4:
+        if kind == 5:
             return F.initcap(self.string(depth - 1))
-        if self.r.rand() < 0.4:
+        if kind == 6:
             return F.substring_index(self.string(depth - 1), "-",
                                      int(self.r.randint(1, 3)))
         return F.when(self.boolean(depth - 1),
