@@ -5,26 +5,85 @@ plan (the Spark-CPU stand-in).
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 value = TPU rows/sec through the pipeline; vs_baseline = TPU throughput /
 CPU-engine throughput (the reference's own headline is 3-7x vs Spark CPU,
-docs/FAQ.md:60-66 — BASELINE.md).
+docs/FAQ.md:60-66 — BASELINE.md).  Extra keys on the same line:
+  vs_pandas_cpu    — TPU throughput / pandas (C groupby) throughput, an
+                     engine-independent CPU baseline.  pyspark itself is
+                     not installable in this zero-egress image, so pandas
+                     is the closest real CPU columnar engine available.
+  data_gb_per_sec  — bytes of input touched / wall time (MFU-style
+                     accounting, shows distance from HBM capability).
+  scan_*           — same pipeline including a parquet scan each run.
+
+Tunnel-proofing: the TPU backend rides a tunnel that can flap for hours
+(round 4 lost its perf evidence to exactly that).  Before importing jax
+in-process we probe the backend in a SUBPROCESS (a failed in-process
+backend init is cached by jax and poisons retries) with bounded backoff,
+and only emit a structured "backend-unavailable" line after the budget
+(env BENCH_BACKEND_WAIT_SECS, default 1800s) is exhausted.
 """
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
-ROWS = 1 << 24  # 16M rows — large enough that per-dispatch round-trip
-PARTS = 4       # latency (~100ms over the tunneled chip) amortizes
+ROWS = int(os.environ.get("BENCH_ROWS", 1 << 24))
+# 16M rows default — large enough that per-dispatch round-trip
+PARTS = 4  # latency (~100ms over the tunneled chip) amortizes
+
+# BENCH_PLATFORM forces a platform for smoke tests (sitecustomize pins
+# JAX_PLATFORMS=axon, so only jax.config.update can override it).
+_FORCE = os.environ.get("BENCH_PLATFORM", "")
+_PROBE = ("import os, jax; "
+          "p = os.environ.get('BENCH_PLATFORM'); "
+          "p and jax.config.update('jax_platforms', p); "
+          "d = jax.devices(); "
+          "import jax.numpy as jnp; "
+          "x = jnp.arange(8) + 1; assert int(x.sum()) == 36; "
+          "print(d[0].platform)")
+
+
+def wait_for_backend() -> str:
+    """Poll the jax backend in a subprocess until it answers (or the
+    budget runs out).  Returns the platform name, or raises TimeoutError
+    with the last probe error."""
+    budget = float(os.environ.get("BENCH_BACKEND_WAIT_SECS", "1800"))
+    deadline = time.monotonic() + budget
+    interval, last_err = 30.0, "never probed"
+    while True:
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", _PROBE], capture_output=True,
+                text=True, timeout=240)
+            if out.returncode == 0 and out.stdout.strip():
+                return out.stdout.strip().splitlines()[-1]
+            last_err = (out.stderr or "").strip().splitlines()[-1:] or ["?"]
+            last_err = last_err[0][-300:]
+        except subprocess.TimeoutExpired:
+            last_err = "probe timed out after 240s"
+        if time.monotonic() >= deadline:
+            raise TimeoutError(last_err)
+        sys.stderr.write(f"[bench] backend unavailable ({last_err}); "
+                         f"retrying in {interval:.0f}s\n")
+        time.sleep(min(interval, max(0.0, deadline - time.monotonic())))
+        interval = min(interval * 1.5, 120.0)
+
 
 # Persistent XLA compilation cache: the 16M-row kernels take minutes to
 # compile on the tunneled chip; cached executables make warmup near-free
 # on every bench invocation after the first.
 os.makedirs("/tmp/jax_comp_cache", exist_ok=True)
-import jax  # noqa: E402
 
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_comp_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+
+def _configure_jax():
+    import jax
+    if _FORCE:
+        jax.config.update("jax_platforms", _FORCE)
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_comp_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
 
 
 def make_data(rows: int):
@@ -82,7 +141,8 @@ def time_engine(tpu_enabled: bool, data, runs: int = 3) -> float:
     return best
 
 
-SCAN_ROWS = 1 << 22  # 4M-row parquet file for the scan-inclusive metric
+SCAN_ROWS = min(1 << 22, ROWS)  # 4M-row parquet for the scan metric
+# (tracks BENCH_ROWS downward so smoke runs stay small)
 
 
 def _scan_conf(tpu_enabled: bool):
@@ -124,10 +184,49 @@ def time_scan_engine(tpu_enabled: bool, path: str, runs: int = 3) -> float:
     return best
 
 
+def time_pandas(data, runs: int = 3) -> float:
+    """Same q6 pipeline in pandas (C-backed columnar CPU engine) — the
+    engine-independent baseline.  pyspark is not installable here (zero
+    egress); pandas groupby is the nearest real CPU columnar reference."""
+    import pandas as pd
+    df = pd.DataFrame({k: v for k, (_, v) in data.items()})
+    best = float("inf")
+    for _ in range(runs):
+        t0 = time.monotonic()
+        f = df[(df["ss_quantity"] < 25) & (df["ss_ext_discount_amt"] > 10.0)]
+        f = f.assign(revenue=f["ss_sales_price"] * f["ss_ext_discount_amt"])
+        out = (f.groupby("ss_item_sk")
+                .agg(sum_rev=("revenue", "sum"),
+                     cnt=("revenue", "count"),
+                     avg_price=("ss_sales_price", "mean"))
+                .sort_index())
+        best = min(best, time.monotonic() - t0)
+    assert len(out), "empty pandas result"
+    return best
+
+
+def _bytes_per_row(data) -> int:
+    return sum(int(np.asarray(v).dtype.itemsize) for _, v in data.values())
+
+
 def main():
+    try:
+        platform = wait_for_backend()
+    except TimeoutError as e:
+        print(json.dumps({
+            "metric": "q6_like_rows_per_sec", "value": 0.0, "unit": "rows/s",
+            "vs_baseline": 0.0, "error": "backend-unavailable",
+            "detail": str(e),
+            "wait_budget_secs": float(
+                os.environ.get("BENCH_BACKEND_WAIT_SECS", "1800")),
+        }))
+        return
+    sys.stderr.write(f"[bench] backend up: platform={platform}\n")
+    _configure_jax()
     data = make_data(ROWS)
     tpu_t = time_engine(True, data)
     cpu_t = time_engine(False, data)
+    pandas_t = time_pandas(data)
     value = ROWS / tpu_t
     vs = cpu_t / tpu_t
 
@@ -152,6 +251,10 @@ def main():
         "value": round(value, 1),
         "unit": "rows/s",
         "vs_baseline": round(vs, 3),
+        "vs_pandas_cpu": round(pandas_t / tpu_t, 3),
+        "data_gb_per_sec": round(ROWS * _bytes_per_row(data) / tpu_t / 1e9,
+                                 3),
+        "platform": platform,
         "scan_rows_per_sec": round(SCAN_ROWS / scan_tpu, 1),
         "scan_vs_baseline": round(scan_cpu / scan_tpu, 3),
     }))

@@ -257,6 +257,18 @@ def _element_slots(v, cap):
     return rows, in_range
 
 
+def _host_isnan(value) -> bool:
+    return isinstance(value, float) and value != value
+
+
+def _needle_eq(e, needle) -> bool:
+    """Ordering equivalence for array membership (Spark ArrayContains /
+    ArrayPosition): NaN equals NaN, unlike IEEE ==."""
+    if _host_isnan(needle):
+        return isinstance(e, float) and e != e
+    return e == needle
+
+
 def _check_array_needle(elem_dt, value):
     """Reject needles whose python type does not match the element type
     (a silent narrowing cast would diverge between backends)."""
@@ -321,7 +333,11 @@ class ArrayContains(Expression):
         needle = jnp.asarray(self.children[1].value,
                              dtype=elem_dt.jnp_dtype)
         rows, in_range = _element_slots(v, cap)
-        hit = in_range & (v.data == needle)
+        # Spark's ArrayContains uses ordering equivalence: NaN == NaN
+        if elem_dt.is_fractional and _host_isnan(self.children[1].value):
+            hit = in_range & jnp.isnan(v.data)
+        else:
+            hit = in_range & (v.data == needle)
         n_hits = jax.ops.segment_sum(hit.astype(jnp.int32), rows,
                                      num_segments=cap,
                                      indices_are_sorted=True)
@@ -339,7 +355,8 @@ class ArrayContains(Expression):
         for i, (arr, ok) in enumerate(zip(v.values, v.validity)):
             if not (ok and arr is not None):
                 continue
-            hit = any(e is not None and e == needle for e in arr)
+            hit = any(e is not None and _needle_eq(e, needle)
+                      for e in arr)
             out[i] = hit
             if not hit and any(e is None for e in arr):
                 valid[i] = False  # Spark: NULL element + no match -> NULL
@@ -549,7 +566,10 @@ class ArrayPosition(Expression):
                              dtype=elem_dt.jnp_dtype)
         rows, in_range = _element_slots(v, cap)
         pos = jnp.arange(int(v.data.shape[0]), dtype=jnp.int32)
-        hit = in_range & (v.data == needle)
+        if elem_dt.is_fractional and _host_isnan(self.children[1].value):
+            hit = in_range & jnp.isnan(v.data)
+        else:
+            hit = in_range & (v.data == needle)
         big = jnp.int32(1 << 30)
         first = jax.ops.segment_min(jnp.where(hit, pos, big), rows,
                                     num_segments=cap,
@@ -570,7 +590,7 @@ class ArrayPosition(Expression):
         for i, (arr, ok) in enumerate(zip(v.values, v.validity)):
             if ok and arr is not None:
                 for j, e in enumerate(arr):
-                    if e is not None and e == needle:
+                    if e is not None and _needle_eq(e, needle):
                         out[i] = j + 1
                         break
         return CpuVal(T.LONG, out, v.validity)

@@ -74,8 +74,10 @@ _CODECS: Dict[str, Callable[[], Codec]] = {
     "copy": CopyCodec,
     "uncompressed": CopyCodec,
     "zlib": ZlibCodec,
+    # NOTE: deliberately NOT aliased as "lz4" — the wire format (1-byte
+    # raw/compressed header + bespoke token stream) is not interoperable
+    # with standard LZ4 frames/blocks (ADVICE r4).
     "nativelz": NativeLZCodec,
-    "lz4": NativeLZCodec,
 }
 
 

@@ -126,6 +126,12 @@ def _bounded_range_bounds(frame: WindowFrame, segs: _Segments,
     kd = okey.data
     # widen so k + offset cannot wrap in a narrow key dtype
     kd = kd.astype(jnp.int64) if okey.dtype.is_integral or         okey.dtype in (T.DATE, T.TIMESTAMP) else kd.astype(jnp.float64)
+    if not ascending and kd.dtype == jnp.int64:
+        # -INT64_MIN wraps; saturate one ulp first.  INT64_MIN and
+        # INT64_MIN+1 become frame-peers at that one extreme
+        # (docs/compatibility.md).
+        imin = jnp.int64(jnp.iinfo(jnp.int64).min)
+        kd = jnp.where(kd == imin, imin + 1, kd)
     keys = kd if ascending else -kd
     is_nan = jnp.isnan(keys) if okey.dtype.is_fractional else         jnp.zeros_like(okey.validity)
     finite = okey.validity & ~is_nan
@@ -147,15 +153,30 @@ def _bounded_range_bounds(frame: WindowFrame, segs: _Segments,
     else:
         lo0 = lo0 + nans_in_seg
     k = keys
+
+    def _target(off):
+        # k + off with SATURATING int64 arithmetic: near INT64_MAX /
+        # INT64_MIN a wrapped target flips the binary-search ordering
+        # and produces empty frames (round-5 review finding).
+        if k.dtype != jnp.int64:
+            return k + off
+        info = jnp.iinfo(jnp.int64)
+        off = int(off)
+        if off >= 0:
+            return jnp.where(k > info.max - off, jnp.int64(info.max),
+                             k + jnp.int64(off))
+        return jnp.where(k < info.min - off, jnp.int64(info.min),
+                         k + jnp.int64(off))
+
     if frame.start is None:
         a = segs.seg_start_pos  # partition edge, null/NaN blocks included
     else:
-        a = _search_boundary(keys, k + frame.start, lo0, hi0,
+        a = _search_boundary(keys, _target(frame.start), lo0, hi0,
                              strict=False)
     if frame.end is None:
         b = segs.seg_end_pos
     else:
-        b = _search_boundary(keys, k + frame.end, lo0, hi0,
+        b = _search_boundary(keys, _target(frame.end), lo0, hi0,
                              strict=True) - 1
     a = jnp.where(finite, a, segs.peer_start_pos)
     b = jnp.where(finite, b, segs.peer_end_pos)

@@ -50,11 +50,15 @@ def _canon(rows, approx, ignore_order):
 
 
 def assert_tpu_cpu_equal(build_fn, approx=False, ignore_order=True,
-                         confs=None, expect_fallback=None):
+                         confs=None, expect_fallback=None,
+                         forbid_fallback=None):
     """build_fn(session) -> DataFrame; runs on both engines and compares.
 
     expect_fallback: optional operator-name substring expected in the explain
     output's cannot-run list (assert_gpu_fallback_collect analogue).
+    forbid_fallback: operator-name substring that must NOT appear in the
+    cannot-run list — guards against a regression test silently comparing
+    CPU against CPU.
     """
     confs = confs or {}
     cpu = cpu_session(**confs)
@@ -66,6 +70,11 @@ def assert_tpu_cpu_equal(build_fn, approx=False, ignore_order=True,
         explain = tpu.last_explain
         assert expect_fallback in explain and "cannot run on TPU" in explain, \
             f"expected fallback of {expect_fallback}; explain:\n{explain}"
+    if forbid_fallback:
+        explain = tpu.last_explain
+        assert not any(forbid_fallback in ln for ln in
+                       explain.splitlines() if "cannot run on TPU" in ln), \
+            f"unexpected fallback of {forbid_fallback}; explain:\n{explain}"
     a = _canon(cpu_rows, approx, ignore_order)
     b = _canon(tpu_rows, approx, ignore_order)
     assert len(a) == len(b), \

@@ -76,3 +76,65 @@ class TestLagLeadStringDefault:
             return df.with_column("p", F.lag("s", 1, "DEFAULT").over(w))
 
         assert_tpu_cpu_equal(q, expect_fallback="Lag")
+
+
+class TestAdviceRound4:
+    """Regression coverage for the round-4 advisor findings (ADVICE.md)."""
+
+    def test_array_contains_nan_needle_matches_nan(self):
+        data = {"a": [[1.0, float("nan")], [1.0, 2.0], None, [float("nan")]],
+                "x": [1, 2, 3, 4]}
+
+        def q(s):
+            df = s.create_dataframe(data, num_partitions=1)
+            return df.with_column(
+                "hit", F.array_contains(df["a"], float("nan")))
+
+        assert_tpu_cpu_equal(q)
+
+    def test_array_position_nan_needle(self):
+        data = {"a": [[1.0, float("nan"), 3.0], [2.0, 2.5], [float("nan")]],
+                "x": [1, 2, 3]}
+
+        def q(s):
+            df = s.create_dataframe(data, num_partitions=1)
+            return df.with_column(
+                "pos", F.array_position(df["a"], float("nan")))
+
+        assert_tpu_cpu_equal(q)
+
+    def test_range_frame_desc_int64_min_no_wrap(self):
+        imin = -(2 ** 63)
+        data = {"g": [1, 1, 1, 1], "k": [imin, imin + 1, 5, 100],
+                "v": [1, 2, 3, 4]}
+
+        def q(s):
+            df = s.create_dataframe(data, num_partitions=1)
+            w = (Window.partition_by("g")
+                 .order_by(df["k"].desc())
+                 .range_between(-1, 1))
+            return df.with_column("sv", F.sum("v").over(w))
+
+        # int sum: no float-agg gate, so the window genuinely runs on TPU
+        assert_tpu_cpu_equal(q, forbid_fallback="Window")
+
+    def test_range_frame_asc_int64_max_no_wrap(self):
+        imax = 2 ** 63 - 1
+        data = {"g": [1, 1, 1, 1], "k": [imax, imax - 1, 5, 100],
+                "v": [1, 2, 3, 4]}
+
+        def q(s):
+            df = s.create_dataframe(data, num_partitions=1)
+            w = (Window.partition_by("g")
+                 .order_by("k")
+                 .range_between(-1, 1))
+            return df.with_column("sv", F.sum("v").over(w))
+
+        assert_tpu_cpu_equal(q, forbid_fallback="Window")
+
+    def test_lz4_codec_alias_removed(self):
+        import pytest
+        from spark_rapids_tpu.mem.codec import get_codec
+        with pytest.raises(ValueError):
+            get_codec("lz4")
+        assert get_codec("nativelz") is not None
