@@ -91,6 +91,7 @@ def make_data(rows: int):
     rng = np.random.RandomState(42)
     return {
         "ss_item_sk": (T.INT, rng.randint(0, 2000, rows)),
+        "ss_promo_sk": (T.INT, rng.randint(0, 3, rows)),
         "ss_quantity": (T.INT, rng.randint(1, 101, rows)),
         "ss_sales_price": (T.DOUBLE, (rng.rand(rows) * 200).round(2)),
         "ss_ext_discount_amt": (T.DOUBLE, (rng.rand(rows) * 100).round(2)),
@@ -105,16 +106,22 @@ def build_query(session, data):
     # over the axon tunnel host->HBM bandwidth is an environment artifact,
     # not a TPU property.
     df = df.cache()
+    # Round 5: the headline grew a second grouping key and min/max aggs —
+    # it now exercises the GENERALIZED slot kernel (mixed-radix multi-key
+    # packing + scatter min/max), not just the single-key sum/count/avg
+    # einsum the round-4 bench was shaped to.
     return (df
             .filter((df["ss_quantity"] < 25) &
                     (df["ss_ext_discount_amt"] > 10.0))
             .with_column("revenue",
                          df["ss_sales_price"] * df["ss_ext_discount_amt"])
-            .group_by("ss_item_sk")
+            .group_by("ss_item_sk", "ss_promo_sk")
             .agg(F.sum("revenue").alias("sum_rev"),
                  F.count("revenue").alias("cnt"),
-                 F.avg("ss_sales_price").alias("avg_price"))
-            .order_by("ss_item_sk"))
+                 F.avg("ss_sales_price").alias("avg_price"),
+                 F.min("ss_sales_price").alias("min_price"),
+                 F.max("revenue").alias("max_rev"))
+            .order_by("ss_item_sk", "ss_promo_sk"))
 
 
 def time_engine(tpu_enabled: bool, data, runs: int = 3) -> float:
@@ -195,10 +202,12 @@ def time_pandas(data, runs: int = 3) -> float:
         t0 = time.monotonic()
         f = df[(df["ss_quantity"] < 25) & (df["ss_ext_discount_amt"] > 10.0)]
         f = f.assign(revenue=f["ss_sales_price"] * f["ss_ext_discount_amt"])
-        out = (f.groupby("ss_item_sk")
+        out = (f.groupby(["ss_item_sk", "ss_promo_sk"])
                 .agg(sum_rev=("revenue", "sum"),
                      cnt=("revenue", "count"),
-                     avg_price=("ss_sales_price", "mean"))
+                     avg_price=("ss_sales_price", "mean"),
+                     min_price=("ss_sales_price", "min"),
+                     max_rev=("revenue", "max"))
                 .sort_index())
         best = min(best, time.monotonic() - t0)
     assert len(out), "empty pandas result"
@@ -232,11 +241,15 @@ def main():
 
     # scan-inclusive secondary metric (same JSON line: the driver parses
     # one line; extra keys carry the second benchmark)
+    import hashlib
     import tempfile
-    # row count in the dir name: a SCAN_ROWS/schema change can never
-    # silently reuse a stale file
+    # row count + schema fingerprint in the dir name: a SCAN_ROWS or
+    # make_data schema change can never silently reuse a stale file
+    sig = hashlib.sha1(repr([(k, str(t), np.asarray(v).dtype.str)
+                             for k, (t, v) in data.items()])
+                       .encode()).hexdigest()[:8]
     scan_dir = os.path.join(tempfile.gettempdir(),
-                            f"rapids_tpu_bench_pq_{SCAN_ROWS}")
+                            f"rapids_tpu_bench_pq_{SCAN_ROWS}_{sig}")
     scan_file = os.path.join(scan_dir, "part-00000.parquet")
     if not os.path.exists(scan_file):
         from spark_rapids_tpu.session import TpuSparkSession

@@ -232,7 +232,10 @@ SHUFFLE_PARTITIONS = conf_int(
     "Number of partitions used for shuffle exchanges.")
 SHUFFLE_COMPRESSION_CODEC = conf_str(
     "spark.rapids.shuffle.compression.codec", "copy",
-    "Codec for compressing shuffled table buffers (copy = passthrough).")
+    "Codec for compressing shuffled table buffers (copy = passthrough). "
+    "`nativelz` is the project-specific C++ LZ-family block codec — its "
+    "wire format is NOT standard LZ4; there is deliberately no `lz4` "
+    "alias.")
 STRING_HASH_JOIN = conf_bool(
     "spark.rapids.sql.stringHashGroupJoin.enabled", True,
     "Group by / join on string keys via 64-bit hashes computed on device; "
@@ -292,11 +295,18 @@ AQE_SKEW_FACTOR = conf_float(
 HASH_AGG_MXU_ENABLED = conf_bool(
     "spark.rapids.sql.agg.mxuHash.enabled", True,
     "Aggregate update batches on the MXU via slot one-hot contractions "
-    "when the agg list is sum/count/avg and the group key is one "
-    "integral/date/bool column: one matmul replaces the sort-based "
-    "groupby's argsort + gathers + scatters.  Batches whose key range "
-    "exceeds the slot table (or float sums over NaN/Inf) transparently "
-    "re-run the exact sort path.")
+    "when the agg list is sum/count/avg/min/max/first/last and the group "
+    "keys are integral/date/bool columns (multi-key via mixed-radix slot "
+    "packing): one matmul (plus a scatter pass for min/max-class aggs) "
+    "replaces the sort-based groupby's argsort + gathers + scatters.  "
+    "Batches whose packed key space exceeds the slot table (or float "
+    "sums over NaN/Inf) transparently re-run the exact sort path.")
+HASH_AGG_MXU_SLOTS = conf_int(
+    "spark.rapids.sql.agg.mxuHash.tableSlots", 8192,
+    "Slot-table capacity of the MXU hash aggregate: the product of the "
+    "per-key value ranges (plus one per nullable key) must fit here or "
+    "the batch falls back to the sort path.  Larger tables admit wider "
+    "key spaces at the cost of one-hot contraction FLOPs/memory.")
 NLJ_PAIR_CAPACITY = conf_int(
     "spark.rapids.sql.nestedLoopJoin.pairCapacity", 1 << 22,
     "Max cross-pair slots a single nested-loop-join step may allocate; "

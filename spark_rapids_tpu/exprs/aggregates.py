@@ -11,6 +11,7 @@ result projection (e.g. avg = sum / count).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from typing import List, Optional, Sequence, Tuple
 
@@ -20,6 +21,26 @@ import numpy as np
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.exprs.base import CpuVal, DevVal, Expression, Literal
+
+# Trace-time flag: the sort-groupby path feeds segment kernels seg_ids in
+# ascending order; the MXU hash-agg slot path feeds them UNSORTED.
+# ``indices_are_sorted`` is a correctness contract for TPU scatter
+# lowering, not just a speed hint, so the hash path must trace with it
+# off (kernels/hashagg.py wraps its segment_update calls).
+_SEG_IDS_SORTED = [True]
+
+
+def _seg_sorted() -> bool:
+    return _SEG_IDS_SORTED[-1]
+
+
+@contextlib.contextmanager
+def unsorted_segment_ids():
+    _SEG_IDS_SORTED.append(False)
+    try:
+        yield
+    finally:
+        _SEG_IDS_SORTED.pop()
 
 
 def _sum_result_type(dt: T.DataType) -> T.DataType:
@@ -81,7 +102,7 @@ class AggregateFunction(Expression):
 def _seg_any_valid(valid, seg_ids, num_segments, live_mask):
     # scatter-ADD (not max): adds combine in-lane on TPU scatters
     return jax.ops.segment_sum((valid & live_mask).astype(jnp.int32), seg_ids,
-                               num_segments=num_segments, indices_are_sorted=True) > 0
+                               num_segments=num_segments, indices_are_sorted=_seg_sorted()) > 0
 
 
 class Sum(AggregateFunction):
@@ -96,7 +117,7 @@ class Sum(AggregateFunction):
         x = v.data.astype(self.dtype.jnp_dtype)
         use = v.validity & live_mask
         s = jax.ops.segment_sum(jnp.where(use, x, 0), seg_ids,
-                                num_segments=num_segments, indices_are_sorted=True)
+                                num_segments=num_segments, indices_are_sorted=_seg_sorted())
         any_v = _seg_any_valid(v.validity, seg_ids, num_segments, live_mask)
         ones = jnp.ones(num_segments, dtype=jnp.bool_)
         return [DevVal(self.dtype, s, ones), DevVal(T.BOOLEAN, any_v, ones)]
@@ -104,7 +125,7 @@ class Sum(AggregateFunction):
     def segment_merge(self, buffers, seg_ids, num_segments, live_mask):
         s, has = buffers
         total = jax.ops.segment_sum(
-            jnp.where(live_mask, s.data, 0), seg_ids, num_segments=num_segments, indices_are_sorted=True)
+            jnp.where(live_mask, s.data, 0), seg_ids, num_segments=num_segments, indices_are_sorted=_seg_sorted())
         any_v = _seg_any_valid(has.data.astype(jnp.bool_), seg_ids,
                                num_segments, live_mask)
         ones = jnp.ones(num_segments, dtype=jnp.bool_)
@@ -141,14 +162,14 @@ class Count(AggregateFunction):
         # < 2^31 rows so the per-batch count cannot overflow
         c32 = jax.ops.segment_sum(use.astype(jnp.int32), seg_ids,
                                   num_segments=num_segments,
-                                  indices_are_sorted=True)
+                                  indices_are_sorted=_seg_sorted())
         c = c32.astype(jnp.int64)
         return [DevVal(T.LONG, c, jnp.ones(num_segments, dtype=jnp.bool_))]
 
     def segment_merge(self, buffers, seg_ids, num_segments, live_mask):
         c = jax.ops.segment_sum(
             jnp.where(live_mask, buffers[0].data, 0), seg_ids,
-            num_segments=num_segments, indices_are_sorted=True)
+            num_segments=num_segments, indices_are_sorted=_seg_sorted())
         return [DevVal(T.LONG, c, jnp.ones(num_segments, dtype=jnp.bool_))]
 
     def finalize(self, buffers):
@@ -185,8 +206,8 @@ class _MinMax(AggregateFunction):
 
     def _seg_reduce(self, x, seg_ids, num_segments):
         if self._is_min:
-            return jax.ops.segment_min(x, seg_ids, num_segments=num_segments, indices_are_sorted=True)
-        return jax.ops.segment_max(x, seg_ids, num_segments=num_segments, indices_are_sorted=True)
+            return jax.ops.segment_min(x, seg_ids, num_segments=num_segments, indices_are_sorted=_seg_sorted())
+        return jax.ops.segment_max(x, seg_ids, num_segments=num_segments, indices_are_sorted=_seg_sorted())
 
     def segment_update(self, v, seg_ids, num_segments, live_mask):
         use = v.validity & live_mask
@@ -240,20 +261,20 @@ class Average(AggregateFunction):
         use = v.validity & live_mask
         x = v.data.astype(jnp.float64)
         s = jax.ops.segment_sum(jnp.where(use, x, 0.0), seg_ids,
-                                num_segments=num_segments, indices_are_sorted=True)
+                                num_segments=num_segments, indices_are_sorted=_seg_sorted())
         # count in i32 (native scatter lanes), widened after — see Count
         c = jax.ops.segment_sum(use.astype(jnp.int32), seg_ids,
                                 num_segments=num_segments,
-                                indices_are_sorted=True).astype(jnp.int64)
+                                indices_are_sorted=_seg_sorted()).astype(jnp.int64)
         ones = jnp.ones(num_segments, dtype=jnp.bool_)
         return [DevVal(T.DOUBLE, s, ones), DevVal(T.LONG, c, ones)]
 
     def segment_merge(self, buffers, seg_ids, num_segments, live_mask):
         s, c = buffers
         st = jax.ops.segment_sum(jnp.where(live_mask, s.data, 0.0), seg_ids,
-                                 num_segments=num_segments, indices_are_sorted=True)
+                                 num_segments=num_segments, indices_are_sorted=_seg_sorted())
         ct = jax.ops.segment_sum(jnp.where(live_mask, c.data, 0), seg_ids,
-                                 num_segments=num_segments, indices_are_sorted=True)
+                                 num_segments=num_segments, indices_are_sorted=_seg_sorted())
         ones = jnp.ones(num_segments, dtype=jnp.bool_)
         return [DevVal(T.DOUBLE, st, ones), DevVal(T.LONG, ct, ones)]
 
@@ -296,9 +317,9 @@ class _FirstLast(AggregateFunction):
         big = jnp.int64(jnp.iinfo(jnp.int64).max // 2)
         key = jnp.where(candidate, idx, big if self._is_first else -big)
         if self._is_first:
-            best = jax.ops.segment_min(key, seg_ids, num_segments=num_segments, indices_are_sorted=True)
+            best = jax.ops.segment_min(key, seg_ids, num_segments=num_segments, indices_are_sorted=_seg_sorted())
         else:
-            best = jax.ops.segment_max(key, seg_ids, num_segments=num_segments, indices_are_sorted=True)
+            best = jax.ops.segment_max(key, seg_ids, num_segments=num_segments, indices_are_sorted=_seg_sorted())
         # Scatter values of winners into group slots.
         winner = candidate & (best[seg_ids] == key)
         out_val = jnp.zeros(num_segments, dtype=v_data.dtype)
@@ -308,7 +329,7 @@ class _FirstLast(AggregateFunction):
         out_ok = out_ok.at[jnp.where(winner, seg_ids, num_segments)].set(
             v_valid, mode="drop")
         has = jax.ops.segment_max(candidate.astype(jnp.int32), seg_ids,
-                                  num_segments=num_segments, indices_are_sorted=True) > 0
+                                  num_segments=num_segments, indices_are_sorted=_seg_sorted()) > 0
         best_idx = jnp.where(has, best, 0)
         return out_val, out_ok & has, best_idx
 
@@ -476,9 +497,9 @@ class _CentralMoment(AggregateFunction):
         x = jnp.where(use, v.data.astype(jnp.float64), 0.0)
         n = jax.ops.segment_sum(use.astype(jnp.float64), seg_ids,
                                 num_segments=num_segments,
-                                indices_are_sorted=True)
+                                indices_are_sorted=_seg_sorted())
         s1 = jax.ops.segment_sum(x, seg_ids, num_segments=num_segments,
-                                 indices_are_sorted=True)
+                                 indices_are_sorted=_seg_sorted())
         # two-pass m2: deviations from the per-group mean, NOT the
         # cancellation-prone Σx² − (Σx)²/n (large-mean data — e.g. epoch
         # timestamps — loses every significant digit under that form)
@@ -486,7 +507,7 @@ class _CentralMoment(AggregateFunction):
         d = jnp.where(use, x - mean[seg_ids], 0.0)
         m2 = jax.ops.segment_sum(d * d, seg_ids,
                                  num_segments=num_segments,
-                                 indices_are_sorted=True)
+                                 indices_are_sorted=_seg_sorted())
         ones = jnp.ones(num_segments, dtype=jnp.bool_)
         return [DevVal(T.DOUBLE, n, ones),
                 DevVal(T.DOUBLE, s1, ones),   # n*mean = Σx
@@ -497,10 +518,10 @@ class _CentralMoment(AggregateFunction):
         live = live_mask.astype(jnp.float64)
         s0 = jax.ops.segment_sum(n_i * live, seg_ids,
                                  num_segments=num_segments,
-                                 indices_are_sorted=True)
+                                 indices_are_sorted=_seg_sorted())
         s1 = jax.ops.segment_sum(nm_i * live, seg_ids,
                                  num_segments=num_segments,
-                                 indices_are_sorted=True)
+                                 indices_are_sorted=_seg_sorted())
         # deviation form of Chan's combine: m2 = Σm2ᵢ + Σnᵢ·(meanᵢ−mean)²
         # — the Σnᵢ·meanᵢ² − n·mean² form cancels catastrophically for
         # large means (epoch-scale data), this one never does
@@ -509,7 +530,7 @@ class _CentralMoment(AggregateFunction):
         dev = mean_i - mean[seg_ids]
         m2 = jax.ops.segment_sum((m2_i + n_i * dev * dev) * live, seg_ids,
                                  num_segments=num_segments,
-                                 indices_are_sorted=True)
+                                 indices_are_sorted=_seg_sorted())
         ones = jnp.ones(num_segments, dtype=jnp.bool_)
         return [DevVal(T.DOUBLE, s0, ones), DevVal(T.DOUBLE, s1, ones),
                 DevVal(T.DOUBLE, m2, ones)]

@@ -10,13 +10,20 @@ segmented reduction:
   slot = key - min(key)                       # elementwise, EXACT
   sums = stacked_value_rows @ one_hot(slot)   # ONE einsum on the MXU
 
-Slotting by the key's own value range (single integral/date/bool key, or
-keyless) makes slot <-> key a bijection — no hash, no collisions, no
-purity machinery, and the output key column is reconstructed from slot
-indices without touching the input again.  A batch whose key range
-exceeds the table (or holds non-finite floats for a float sum) raises a
+Slotting by the key's own value range makes slot <-> key a bijection —
+no hash, no collisions, no purity machinery, and the output key columns
+are reconstructed from slot indices without touching the input again.
+Multi-column keys pack into ONE slot index by mixed radix: each
+integral/date/bool key contributes a digit (its offset from the batch
+minimum, plus a NULL digit when the column has NULLs) and the product of
+radices must fit the table.  A batch whose packed key space exceeds the
+table (or holds non-finite floats for a float sum) raises a
 device-visible flag and the caller re-runs the exact sort path —
 correctness never depends on data shape.
+
+min/max/first/last ride the SAME slot index through the aggregate
+classes' own segment kernels (one scatter-reduce pass, unsorted ids) —
+bit-identical buffers and semantics to the sort path, minus the argsort.
 
 Exactness of the reductions:
 * Integer sums/counts ride 8-bit limb rows accumulated in f32 over
@@ -107,34 +114,61 @@ def hash_group_aggregate(batch: ColumnBatch, key_vals: List[DevVal],
     by the merge stage).  ``fallback`` True means the key range did not
     fit the slot table (or a float sum saw non-finite values) — the
     caller MUST discard the result and use the sort path."""
-    from spark_rapids_tpu.exprs.aggregates import Average, Count, Sum
+    from spark_rapids_tpu.exprs.aggregates import (
+        Average, Count, First, Last, Max, Min, Sum, unsorted_segment_ids,
+    )
 
     cap = batch.capacity
     c = min(_CHUNK, cap)
     nc = cap // c
     live = jnp.arange(cap, dtype=jnp.int32) < batch.num_rows
-    kv = key_vals[0]
-    kx = kv.data.astype(jnp.int64)
-    usek = live & kv.validity
-    any_key = jnp.any(usek)
-    big = jnp.int64(jnp.iinfo(jnp.int64).max)
-    kmin = jnp.min(jnp.where(usek, kx, big))
-    kmax = jnp.max(jnp.where(usek, kx, jnp.int64(jnp.iinfo(jnp.int64).min)))
-    # wrap-around of (kmax - kmin) goes negative -> correctly rejected
-    in_range = (kmax - kmin >= 0) & (kmax - kmin < table)
-    fallback = any_key & ~in_range
-    kmin = jnp.where(any_key & in_range, kmin, jnp.int64(0))
 
-    # slots: 0..table-1 = key values, table = NULL-key group, table+1 dead
+    # ---- mixed-radix slot packing over all key columns -------------------
+    # digit_i = k_i - min_i (or range_i for NULL); radix_i = range_i +
+    # has_null_i; slot = sum(digit_i * stride_i).  Bijective onto
+    # [0, prod(radix)); fallback when the packed space exceeds table+1.
+    i64max = jnp.int64(jnp.iinfo(jnp.int64).max)
+    i64min = jnp.int64(jnp.iinfo(jnp.int64).min)
+    fallback = jnp.asarray(False)
+    slot64 = jnp.zeros(cap, jnp.int64)
+    stride = jnp.int64(1)
+    prod_f = jnp.float64(1.0)
+    key_decode = []  # (kmin, rng, radix, stride) per key, for output
+    for kv in key_vals:
+        kx = kv.data.astype(jnp.int64)
+        usek = live & kv.validity
+        any_key = jnp.any(usek)
+        has_null = jnp.any(live & ~kv.validity)
+        kmin = jnp.min(jnp.where(usek, kx, i64max))
+        kmax = jnp.max(jnp.where(usek, kx, i64min))
+        # wrap-around of (kmax - kmin) goes negative -> correctly rejected
+        key_fits = (kmax - kmin >= 0) & (kmax - kmin < table + 1)
+        fallback = fallback | (any_key & ~key_fits)
+        kmin = jnp.where(any_key & key_fits, kmin, jnp.int64(0))
+        rng = jnp.where(any_key & key_fits, kmax - kmin + 1, jnp.int64(0))
+        radix = jnp.maximum(rng + has_null.astype(jnp.int64), jnp.int64(1))
+        digit = jnp.where(usek, jnp.clip(kx - kmin, 0, table), rng)
+        slot64 = slot64 + digit * stride
+        key_decode.append((kmin, rng, radix, stride))
+        stride = stride * radix
+        prod_f = prod_f * radix.astype(jnp.float64)
+    # capacity check in f64: an int64 stride product can wrap silently
+    fallback = fallback | (prod_f > jnp.float64(table + 1))
+
+    # slots: 0..table = packed key tuples, table+1 = dead rows
     tt = table + 2
-    off = jnp.clip(kx - kmin, 0, table - 1).astype(jnp.int32)
-    slot = jnp.where(usek, off,
-                     jnp.where(live, jnp.int32(table), jnp.int32(table + 1)))
+    slot = jnp.where(live, jnp.clip(slot64, 0, table).astype(jnp.int32),
+                     jnp.int32(table + 1))
 
     # ---- stacked einsum rows ---------------------------------------------
     rows: List[jnp.ndarray] = [live.astype(jnp.float32)]  # per-slot count
     agg_plan = []                                         # recombination
     for fn, v in zip(agg_fns, agg_inputs):
+        if type(fn) in (Min, Max, First, Last):
+            # one scatter-reduce pass over the same slot ids, via the
+            # aggregate's own segment kernel (sort-path parity)
+            agg_plan.append(("segment", fn, v))
+            continue
         use = v.validity & live
         use_at = len(rows)
         rows.append(use.astype(jnp.float32))              # per-agg count
@@ -198,7 +232,13 @@ def hash_group_aggregate(batch: ColumnBatch, key_vals: List[DevVal],
     buffer_cols: List[List[DevVal]] = []
     for plan, fn in zip(agg_plan, agg_fns):
         kind = plan[0]
-        if kind == "count":
+        if kind == "segment":
+            _, sfn, sv = plan
+            with unsorted_segment_ids():
+                sb = sfn.segment_update(sv, slot, tt, live)
+            bufs = [DevVal(b.dtype, b.data[:ng], b.validity[:ng])
+                    for b in sb]
+        elif kind == "count":
             cnt = totals_i[plan[1]][:ng]
             bufs = [DevVal(T.LONG, cnt, ones_t)]
         elif kind == "int_sum":
@@ -232,15 +272,20 @@ def hash_group_aggregate(batch: ColumnBatch, key_vals: List[DevVal],
         buffer_cols.append(bufs)
 
     # ---- compact used slots; keys reconstructed from slot indices -------
+    # (mixed-radix decode: digit_i = (slot // stride_i) % radix_i; the
+    # NULL digit rng_i decodes to validity False)
     idx, n_groups = compaction_indices(used, jnp.asarray(ng, jnp.int32))
     out_cap = round_up_capacity(ng)
     idx_p = jnp.pad(idx, (0, out_cap - idx.shape[0]))
-    kf = key_schema.fields[0]
-    key_data = (idx_p.astype(jnp.int64) + kmin).astype(kf.dtype.jnp_dtype)
-    key_valid = (idx_p < table) & \
-        (jnp.arange(out_cap, dtype=jnp.int32) < n_groups)
-    key_col = DeviceColumn(kf.dtype, key_data, key_valid, None)
-    group_keys = ColumnBatch(key_schema, [key_col], n_groups, out_cap)
+    live_out = jnp.arange(out_cap, dtype=jnp.int32) < n_groups
+    key_cols = []
+    for kf, (kmin, rng, radix, stride) in zip(key_schema.fields,
+                                              key_decode):
+        d = (idx_p.astype(jnp.int64) // stride) % radix
+        key_data = (kmin + d).astype(kf.dtype.jnp_dtype)
+        key_valid = (d < rng) & live_out
+        key_cols.append(DeviceColumn(kf.dtype, key_data, key_valid, None))
+    group_keys = ColumnBatch(key_schema, key_cols, n_groups, out_cap)
 
     def _pad(a):
         return jnp.pad(a, [(0, out_cap - a.shape[0])] +
@@ -254,21 +299,22 @@ def hash_group_aggregate(batch: ColumnBatch, key_vals: List[DevVal],
 
 def hash_agg_capable(mode: str, key_types: List[T.DataType],
                      agg_fns: Sequence) -> bool:
-    """Static capability check: the MXU path covers sum/count/avg over
-    fixed-width inputs, grouped by one integral/date/bool key (slot = key
-    offset) or no key (global reduction)."""
-    from spark_rapids_tpu.exprs.aggregates import Average, Count, Sum
+    """Static capability check: the MXU path covers sum/count/avg (einsum
+    limb rows) plus min/max/first/last (slot scatter-reduce) over
+    fixed-width inputs, grouped by any number of integral/date/bool keys
+    (mixed-radix slot packing) or no key (global reduction)."""
+    from spark_rapids_tpu.exprs.aggregates import (
+        Average, Count, First, Last, Max, Min, Sum,
+    )
     if mode != "update":
-        return False
-    if len(key_types) > 1:
         return False
     for kt in key_types:
         if not (kt.is_integral or kt in (T.DATE, T.BOOLEAN)):
             return False
     for fn in agg_fns:
-        if type(fn) not in (Sum, Count, Average):
-            return False
-        if type(fn) in (Sum, Average) and (
-                fn.child.dtype.is_string or fn.child.dtype.is_array):
+        if type(fn) in (Sum, Average, Min, Max, First, Last):
+            if fn.child.dtype.is_string or fn.child.dtype.is_array:
+                return False
+        elif type(fn) is not Count:
             return False
     return True

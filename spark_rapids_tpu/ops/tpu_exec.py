@@ -535,6 +535,8 @@ class TpuHashAggregateExec(TpuExec):
         self._hash_capable = hash_agg_capable(
             mode, [e.dtype for e in key_exprs], [a.fn for a in aggs])
         self._hash_disabled = False  # sticky off after a collided batch
+        from spark_rapids_tpu.kernels.hashagg import TABLE_SLOTS
+        self._mxu_table = TABLE_SLOTS  # refreshed from conf in _hash_active
 
         @jax.jit
         def run(batch: ColumnBatch) -> ColumnBatch:
@@ -570,9 +572,14 @@ class TpuHashAggregateExec(TpuExec):
         self._run_hash = jax.jit(run_hash)
 
     def _hash_active(self, ctx) -> bool:
-        from spark_rapids_tpu.config import HASH_AGG_MXU_ENABLED
-        return self._hash_capable and not self._hash_disabled and \
-            HASH_AGG_MXU_ENABLED.get(ctx.conf)
+        from spark_rapids_tpu.config import (
+            HASH_AGG_MXU_ENABLED, HASH_AGG_MXU_SLOTS,
+        )
+        if not (self._hash_capable and not self._hash_disabled and
+                HASH_AGG_MXU_ENABLED.get(ctx.conf)):
+            return False
+        self._mxu_table = HASH_AGG_MXU_SLOTS.get(ctx.conf)
+        return True
 
     def describe(self):
         return f"TpuHashAggregate({self.mode}, keys={len(self.key_exprs)})"
@@ -636,6 +643,7 @@ class TpuHashAggregateExec(TpuExec):
             self._hash_disabled = True
             ctx.metric(self.op_id, "hashAggFallback").add(1)
             return rerun()
+        ctx.metric(self.op_id, "mxuAggBatches").add(len(outs))
         return outs
 
     # -- core ---------------------------------------------------------------
@@ -716,7 +724,7 @@ class TpuHashAggregateExec(TpuExec):
         agg_inputs = [a.fn.child.tpu_eval(ctx) for a in self.aggs]
         group_keys, buffers, num_groups, collided = hash_group_aggregate(
             batch, key_vals, agg_inputs, [a.fn for a in self.aggs],
-            key_schema, self.output_schema)
+            key_schema, self.output_schema, table=self._mxu_table)
         if keyless:
             num_groups = jnp.asarray(1, jnp.int32)
         cols = [] if keyless else list(group_keys.columns)
@@ -814,6 +822,7 @@ class TpuHashAggregateExec(TpuExec):
         pairs = [self._run_hash(db) for db in batches]
         flags = jax.device_get([f for _, f in pairs]) if pairs else []
         if not any(bool(f) for f in flags):
+            ctx.metric(self.op_id, "mxuAggBatches").add(len(pairs))
             return [p for p, _ in pairs]
         self._hash_disabled = True
         ctx.metric(self.op_id, "hashAggFallback").add(1)

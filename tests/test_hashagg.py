@@ -6,10 +6,17 @@ import numpy as np
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.dataframe import Column
-from spark_rapids_tpu.exprs.aggregates import Average, Count, Max, Sum
+from spark_rapids_tpu.exprs.aggregates import (
+    Average, Count, First, Last, Max, Min, Sum,
+)
 from spark_rapids_tpu.exprs.base import Alias, ColumnRef
 
 from compare import assert_tpu_cpu_equal, cpu_session, tpu_session
+
+
+def _mxu_engaged(session) -> bool:
+    return any(isinstance(ms, dict) and ms.get("mxuAggBatches", 0) > 0
+               for ms in session.last_metrics.values())
 
 
 def _data(n=4000, key_range=97, with_nan=False):
@@ -115,15 +122,125 @@ def test_mxu_agg_falls_back_on_nan_floats():
             assert abs(tv - v) <= 1e-6 * max(1.0, abs(v)), (k, v, tv)
 
 
-def test_mxu_agg_not_used_with_minmax():
-    """Min/max are not matmul-reducible: the exec must not claim hash
-    capability, and results stay correct on the sort path."""
+def test_mxu_agg_minmax_first_last():
+    """Round 5: min/max/first/last ride the slot index through the
+    aggregates' own segment kernels — the plan keeps hash capability and
+    the MXU path engages (metric-asserted)."""
     from spark_rapids_tpu.kernels.hashagg import hash_agg_capable
-    assert not hash_agg_capable(
-        "update", [T.INT], [Max(ColumnRef("v"))])
-    assert_tpu_cpu_equal(
-        lambda s: s.create_dataframe(_data(), num_partitions=2)
-        .group_by("k").agg(Column(Alias(Max(ColumnRef("v")), "mv"))))
+    assert hash_agg_capable(
+        "update", [T.INT], [Max(ColumnRef("v")), Min(ColumnRef("v"))])
+
+    def q(s):
+        df = s.create_dataframe(_data(), num_partitions=2)
+        return df.group_by("k").agg(
+            Column(Alias(Max(ColumnRef("v")), "mx")),
+            Column(Alias(Min(ColumnRef("v")), "mn")),
+            Column(Alias(Min(ColumnRef("f")), "mf")),
+            Column(Alias(Sum(ColumnRef("v")), "sv")))
+
+    conf = {"spark.rapids.sql.variableFloatAgg.enabled": True}
+    assert_tpu_cpu_equal(q, approx=True, confs=conf)
+    tpu = tpu_session(**conf)
+    q(tpu).collect()
+    assert _mxu_engaged(tpu), tpu.last_metrics
+
+
+def test_mxu_agg_first_last_ordered_input():
+    # first/last are order-sensitive: use a single partition so the CPU
+    # oracle sees the same row order as the device batch
+    n = 600
+    data = {"k": (T.INT, [i % 37 for i in range(n)]),
+            "v": (T.LONG, [None if i % 11 == 0 else i for i in range(n)])}
+
+    def q(s):
+        df = s.create_dataframe(data, num_partitions=1)
+        return df.group_by("k").agg(
+            Column(Alias(First(ColumnRef("v")), "fv")),
+            Column(Alias(Last(ColumnRef("v")), "lv")),
+            Column(Alias(Count(ColumnRef("v")), "cv")))
+
+    assert_tpu_cpu_equal(q)
+    tpu = tpu_session()
+    q(tpu).collect()
+    assert _mxu_engaged(tpu), tpu.last_metrics
+
+
+def test_mxu_agg_multi_key():
+    """Round 5: multiple small-range keys pack into one slot index
+    (mixed radix, NULL digit per nullable column)."""
+    rng = np.random.RandomState(11)
+    n = 3000
+    data = {
+        "a": (T.INT, [None if i % 17 == 0 else int(x)
+                      for i, x in enumerate(rng.randint(0, 50, n))]),
+        "b": (T.INT, [int(x) for x in rng.randint(-3, 4, n)]),
+        "c": (T.BOOLEAN, [None if i % 23 == 0 else bool(x)
+                          for i, x in enumerate(rng.randint(0, 2, n))]),
+        "v": (T.LONG, [int(x) for x in rng.randint(-10**9, 10**9, n)]),
+        "f": (T.DOUBLE, [float(x) for x in
+                         (rng.rand(n) * 1e4 - 5e3).round(3)]),
+    }
+
+    def q(s):
+        df = s.create_dataframe(data, num_partitions=3)
+        return df.group_by("a", "b", "c").agg(
+            Column(Alias(Sum(ColumnRef("v")), "sv")),
+            Column(Alias(Count(ColumnRef("v")), "cv")),
+            Column(Alias(Average(ColumnRef("f")), "af")),
+            Column(Alias(Max(ColumnRef("v")), "mv")))
+
+    conf = {"spark.rapids.sql.variableFloatAgg.enabled": True}
+    assert_tpu_cpu_equal(q, approx=True, confs=conf)
+    tpu = tpu_session(**conf)
+    q(tpu).collect()
+    # 50-ish * 8 * 3 slots << 8192: the packed path must engage
+    assert _mxu_engaged(tpu), tpu.last_metrics
+
+
+def test_mxu_agg_multi_key_product_fallback():
+    """Two keys whose RANGE PRODUCT exceeds the table (each alone fits):
+    exact sort fallback, correct results, fallback metric fires."""
+    rng = np.random.RandomState(13)
+    n = 2000
+    data = {
+        "a": (T.INT, [int(x) for x in rng.randint(0, 200, n)]),
+        "b": (T.INT, [int(x) for x in rng.randint(0, 200, n)]),
+        "v": (T.LONG, [int(x) for x in rng.randint(0, 100, n)]),
+    }
+
+    def q(s):
+        df = s.create_dataframe(data, num_partitions=2)
+        return df.group_by("a", "b").agg(
+            Column(Alias(Sum(ColumnRef("v")), "sv")))
+
+    tpu = tpu_session()
+    cpu = cpu_session()
+    assert sorted(q(tpu).collect()) == sorted(q(cpu).collect())
+    fell_back = any(isinstance(ms, dict) and "hashAggFallback" in ms
+                    for ms in tpu.last_metrics.values())
+    assert fell_back, tpu.last_metrics
+
+
+def test_mxu_agg_widened_table_conf():
+    """tableSlots conf admits a key space the default table rejects."""
+    rng = np.random.RandomState(13)
+    n = 2000
+    data = {
+        "a": (T.INT, [int(x) for x in rng.randint(0, 200, n)]),
+        "b": (T.INT, [int(x) for x in rng.randint(0, 200, n)]),
+        "v": (T.LONG, [int(x) for x in rng.randint(0, 100, n)]),
+    }
+
+    def q(s):
+        df = s.create_dataframe(data, num_partitions=2)
+        return df.group_by("a", "b").agg(
+            Column(Alias(Sum(ColumnRef("v")), "sv")))
+
+    conf = {"spark.rapids.sql.agg.mxuHash.tableSlots": 65536}
+    tpu = tpu_session(**conf)
+    cpu = cpu_session()
+    assert sorted(q(tpu).collect()) == sorted(q(cpu).collect())
+    assert _mxu_engaged(tpu), tpu.last_metrics
 
 
 def test_mxu_agg_keyless_and_empty():
