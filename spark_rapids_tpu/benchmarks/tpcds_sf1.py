@@ -1,0 +1,155 @@
+"""File-backed TPC-DS-like SF1 run for the HARD query class (q64/q14
+multi-way join + sort, q47/q57 windowed monthly deltas, q97 full-outer
+overlap): generate parquet once at a true-SF row scale (store_sales =
+2.9M rows/SF ~ TPC-DS's 2.88M), run each query on the TPU and CPU
+engines from the files, verify agreement, emit a timing table
+(BenchUtils.runBench role, integration_tests/.../BenchUtils.scala:109-240;
+query list order follows tpcds_test.py:21-50).
+
+    python -m spark_rapids_tpu.benchmarks.tpcds_sf1 [--sf 1.0]
+        [--queries q64,q14,q47,q57,q97] [--out BENCH_SFDS.md]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+# tpcds_like generators make store_sales = sf * 100_000 rows; true
+# TPC-DS SF1 store_sales is ~2.88M
+_GEN_PER_TRUE_SF = 29
+
+_TABLES = ("store_sales", "store_returns", "catalog_sales",
+           "catalog_returns", "web_sales", "web_returns", "item",
+           "customer", "customer_address", "household_demographics",
+           "date_dim", "store", "promotion")
+
+
+def _dataset_dir(true_sf: float) -> str:
+    import tempfile
+    return os.path.join(tempfile.gettempdir(),
+                        f"rapids_tpu_tpcds_sf{true_sf:g}")
+
+
+def generate_dataset(true_sf: float, num_partitions: int = 4) -> str:
+    from spark_rapids_tpu.benchmarks import tpcds_like as ds
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.session import TpuSparkSession
+
+    root = _dataset_dir(true_sf)
+    marker = os.path.join(root, "_COMPLETE")
+    gen_sf = true_sf * _GEN_PER_TRUE_SF
+    cols = {n: sorted((k, str(dt)) for k, (dt, _) in t.items())
+            for n, t in ds.build_tables(0.001).items()}
+    fingerprint = json.dumps({"cols": cols, "gen_sf": gen_sf},
+                             sort_keys=True)
+    if os.path.exists(marker) and open(marker).read() == fingerprint:
+        return root
+    s = TpuSparkSession(RapidsConf({"spark.rapids.sql.enabled": False}))
+    os.makedirs(root, exist_ok=True)
+    for name, data in ds.build_tables(gen_sf).items():
+        t0 = time.monotonic()
+        df = s.create_dataframe(data, num_partitions=num_partitions)
+        df.write_parquet(os.path.join(root, name), mode="overwrite")
+        print(f"wrote {name} in {time.monotonic() - t0:.1f}s", flush=True)
+    with open(marker, "w") as f:
+        f.write(fingerprint)
+    return root
+
+
+def _session(tpu: bool, root: str):
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.session import TpuSparkSession
+    s = TpuSparkSession(RapidsConf({
+        "spark.rapids.sql.enabled": tpu,
+        "spark.sql.shuffle.partitions": 4,
+        "spark.rapids.sql.variableFloatAgg.enabled": True,
+    }))
+    for name in _TABLES:
+        df = s.read.parquet(os.path.join(root, name))
+        df = df.cache()  # steady-state timing on both engines
+        df.create_or_replace_temp_view(name)
+    return s
+
+
+def run(true_sf: float, qnames, out_path: str) -> dict:
+    from spark_rapids_tpu.benchmarks.bench_utils import run_bench
+    from spark_rapids_tpu.benchmarks.sf1_run import _checksum
+    from spark_rapids_tpu.benchmarks.tpcds_like import QUERIES
+
+    root = generate_dataset(true_sf)
+    results = {}
+    sessions = {"tpu": _session(True, root), "cpu": _session(False, root)}
+    for qname in qnames:
+        sql = QUERIES[qname]
+        for label, s in sessions.items():
+            rep = run_bench(s, qname, lambda: s.sql(sql),
+                            iterations=1, warmups=1, keep_rows=True)
+            r = results.setdefault(qname, {})
+            r[f"{label}_s"] = round(rep["best_s"], 3)
+            r[f"{label}_check"] = _checksum(rep["rows"])
+            print(f"{label} {qname}: {r[f'{label}_s']}s "
+                  f"rows={r[f'{label}_check'][0]}", flush=True)
+        _write_report(true_sf, results, out_path)
+    rep = _write_report(true_sf, results, out_path)
+    print(f"\nwrote {out_path}; all_agree={rep['all_agree']}", flush=True)
+    return rep
+
+
+def _write_report(true_sf: float, results: dict, out_path: str) -> dict:
+    lines = [
+        f"# TPC-DS-like SF{true_sf:g} file-backed timings (hard queries)",
+        "",
+        f"Parquet-backed (store_sales = "
+        f"{int(true_sf * _GEN_PER_TRUE_SF * 100_000):,} rows); inputs "
+        "device-cached after first read (spillable).  Checksums = (row "
+        "count, rounded numeric sums); both engines must agree.",
+        "",
+        "| query | tpu s | cpu s | speedup | rows | agree |",
+        "|---|---|---|---|---|---|",
+    ]
+    all_ok = True
+    for qname in results:
+        r = results[qname]
+        if "tpu_check" not in r or "cpu_check" not in r:
+            continue
+        tc, cc = r["tpu_check"], r["cpu_check"]
+        ok = tc[0] == cc[0] and len(tc[1]) == len(cc[1]) and all(
+            abs(a - b) <= 1e-4 * max(1.0, abs(a), abs(b))
+            for a, b in zip(tc[1], cc[1]))
+        all_ok = all_ok and ok
+        sp = r["cpu_s"] / r["tpu_s"] if r["tpu_s"] else float("inf")
+        lines.append(f"| {qname} | {r['tpu_s']} | {r['cpu_s']} | "
+                     f"{sp:.2f}x | {tc[0]} | {'yes' if ok else 'NO'} |")
+        r["speedup"] = round(sp, 3)
+        r["agree"] = ok
+    done = [r for r in results.values() if "agree" in r]
+    tot_t = sum(r["tpu_s"] for r in done)
+    tot_c = sum(r["cpu_s"] for r in done)
+    ratio = f"{tot_c / tot_t:.2f}x" if tot_t > 0 else "n/a"
+    lines += ["", f"Total steady-state over {len(done)} queries: "
+              f"tpu {tot_t:.2f}s, cpu {tot_c:.2f}s ({ratio})", ""]
+    with open(out_path, "w") as f:
+        f.write("\n".join(lines))
+    return {"all_agree": all_ok, "queries": results,
+            "total_tpu_s": round(tot_t, 3), "total_cpu_s": round(tot_c, 3)}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sf", type=float, default=1.0)
+    ap.add_argument("--queries", default="q64,q14,q47,q57,q97")
+    ap.add_argument("--out", default="BENCH_SFDS.md")
+    args = ap.parse_args(argv)
+    rep = run(args.sf, [q.strip() for q in args.queries.split(",")],
+              args.out)
+    print(json.dumps({"sf": args.sf, "all_agree": rep["all_agree"],
+                      "total_tpu_s": rep["total_tpu_s"],
+                      "total_cpu_s": rep["total_cpu_s"]}))
+    return 0 if rep["all_agree"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -226,33 +226,44 @@ class DataFrame:
         return DataFrame(self._project_node(exprs, names), self.session)
 
     def _project_node(self, exprs: List[Expression], names: List[str]):
-        """Build a Project, extracting top-level window expressions into
-        Window nodes below it (Spark's ExtractWindowExpressions analogue)."""
+        """Build a Project, hoisting window expressions ANYWHERE in the
+        projection trees into Window nodes below it (Spark's
+        ExtractWindowExpressions analogue — round 5 generalized from
+        top-level-only so e.g. ``x * 100 / sum(x) OVER (...)`` works)."""
         from spark_rapids_tpu.exprs.windows import WindowExpression
 
-        def core_of(e):
-            return e.children[0] if isinstance(e, Alias) else e
+        found: Dict[str, Tuple[str, Any]] = {}  # repr(w) -> (hidden, w)
 
-        win = [(i, core_of(e)) for i, e in enumerate(exprs)
-               if isinstance(core_of(e), WindowExpression)]
-        if not win:
+        def hoist(e):
+            if isinstance(e, WindowExpression):
+                # fingerprint, NOT repr: repr omits frames/offsets/order
+                # flags and would merge semantically different windows
+                key = e.fingerprint()
+                if key not in found:
+                    found[key] = (f"__w{len(found)}", e)
+                hn, _ = found[key]
+                return ColumnRef(hn, e.dtype, True)
+            kids = getattr(e, "children", ())
+            if not kids:
+                return e
+            new_kids = [hoist(c) for c in kids]
+            if all(a is b for a, b in zip(new_kids, kids)):
+                return e
+            return e.with_children(new_kids)
+
+        new_exprs = [hoist(e) for e in exprs]
+        if not found:
             return L.Project(exprs, names, self.plan)
         # group by (partition, order) spec; one Window node per group
-        groups: Dict[str, List[Tuple[int, Any]]] = {}
-        for i, w in win:
+        groups: Dict[str, List[Tuple[str, Any]]] = {}
+        for hn, w in found.values():
             key = f"{[repr(p) for p in w.partition_by]}|" \
                   f"{[(repr(o.child), o.ascending, o.nulls_first) for o in w.order_by]}"
-            groups.setdefault(key, []).append((i, w))
+            groups.setdefault(key, []).append((hn, w))
         child = self.plan
-        new_exprs = list(exprs)
-        for gi, (key, items) in enumerate(groups.items()):
-            wexprs, wnames = [], []
-            for i, w in items:
-                hidden = f"__w{i}"
-                wexprs.append(w)
-                wnames.append(hidden)
-                new_exprs[i] = ColumnRef(hidden, w.dtype, True)
-            child = L.Window(wexprs, wnames, child)
+        for key, items in groups.items():
+            child = L.Window([w for _, w in items], [hn for hn, _ in items],
+                             child)
         resolved = [resolve(e, child.schema) for e in new_exprs]
         return L.Project(resolved, names, child)
 

@@ -102,6 +102,27 @@ class CpuEvalCtx:
         self.base_row_id = 0
 
 
+def _fp(v) -> str:
+    """Encode a value for Expression.fingerprint (mirrors the
+    plan_fingerprint encoder in plan/logical.py)."""
+    if isinstance(v, Expression):
+        return v.fingerprint()
+    if isinstance(v, SortOrder):
+        return f"SO({_fp(v.child)},{v.ascending},{v.nulls_first})"
+    if isinstance(v, (str, int, float, bool, type(None))):
+        return repr(v)
+    if isinstance(v, T.DataType):
+        return str(v)
+    if isinstance(v, T.Schema):
+        return str(v)
+    if isinstance(v, (list, tuple)):
+        return "[" + ",".join(_fp(x) for x in v) + "]"
+    if isinstance(v, dict):
+        return "{" + ",".join(f"{_fp(k)}:{_fp(x)}" for k, x in sorted(
+            v.items(), key=lambda kv: str(kv[0]))) + "}"
+    return f"id:{id(v):x}"
+
+
 class Expression:
     """Declarative expression tree node.
 
@@ -125,6 +146,19 @@ class Expression:
     def __repr__(self) -> str:
         args = ", ".join(repr(c) for c in self.children)
         return f"{self.name}({args})"
+
+    def fingerprint(self) -> str:
+        """Structural identity INCLUDING non-child attributes (Lag.offset,
+        Percentile.percentage, window frames...) — repr() prints only
+        class + children, so two semantically different expressions can
+        share a repr.  Use this for any dedup/reuse keying."""
+        parts = [type(self).__name__]
+        for k, a in sorted(vars(self).items()):
+            if k == "children":
+                continue
+            parts.append(f"{k}={_fp(a)}")
+        kids = ",".join(_fp(c) for c in self.children)
+        return f"{'|'.join(parts)}({kids})"
 
     # -- resolution ---------------------------------------------------------
 

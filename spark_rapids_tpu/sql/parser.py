@@ -49,6 +49,9 @@ class Parser:
         self.toks = tokens
         self.i = 0
         self.session = session
+        # WITH-clause bindings, name -> DataFrame; consulted before the
+        # session catalog so a CTE shadows a view of the same name
+        self.ctes = {}
 
     # -- token helpers ------------------------------------------------------
 
@@ -107,6 +110,23 @@ class Parser:
                     "supported; use INTERSECT [DISTINCT]")
             df = df.intersect(self.parse_select())
         return df
+
+    def parse_statement(self):
+        """[WITH name AS (query) [, ...]] query — CTEs are lazy
+        DataFrames bound into a parser-local namespace (Spark expands
+        CTE references the same way: each reference re-plans the
+        subtree; the plan-fingerprint memo de-duplicates compilation)."""
+        if self._at_ident("with"):
+            self.next()
+            while True:
+                name = self.expect("ident").value
+                self.expect("keyword", "as")
+                self.expect("op", "(")
+                self.ctes[name.lower()] = self.parse_query()
+                self.expect("op", ")")
+                if not self.accept("op", ","):
+                    break
+        return self.parse_query()
 
     def parse_query(self):
         df = self.parse_set_term()
@@ -299,7 +319,7 @@ class Parser:
         if has_agg:
             keys = [resolve(k, df.schema) for k in (group_by or [])]
             key_names = [output_name(k, i) for i, k in enumerate(keys)]
-            key_map = {repr(k): nm for k, nm in zip(keys, key_names)}
+            key_map = {k.fingerprint(): nm for k, nm in zip(keys, key_names)}
             if group_sets is not None:
                 gd = df._grouping_sets([Column(k) for k in keys],
                                        group_sets)
@@ -310,11 +330,21 @@ class Parser:
             for idx, (e, name) in enumerate(projections):
                 nm = name or _default_name(e, idx)
                 if _contains_agg(e):
-                    agg_fn = _extract_single_agg(e)
-                    agg_fn = resolve(agg_fn, df.schema)
-                    aggs.append(Column(Alias(agg_fn, nm)))
-                    agg_map[repr(agg_fn)] = nm
-                    post.append((nm, None))
+                    er = resolve(e, df.schema)
+                    if isinstance(er, A.AggregateFunction):
+                        aggs.append(Column(Alias(er, nm)))
+                        agg_map[er.fingerprint()] = nm
+                        post.append((nm, None))
+                    else:
+                        # post-agg arithmetic (avg(x) * 1.2, sum(a)/sum(b)):
+                        # aggregate the embedded calls under hidden names,
+                        # then project the expression over the agg output
+                        for a in _collect_aggs(er):
+                            if a.fingerprint() not in agg_map:
+                                hn = f"__agg_{len(agg_map)}"
+                                aggs.append(Column(Alias(a, hn)))
+                                agg_map[a.fingerprint()] = hn
+                        post.append((nm, ("postagg", er)))
                 else:
                     post.append((nm, resolve(e, df.schema)))
             # HAVING may reference aggregates not in the projection list
@@ -322,10 +352,10 @@ class Parser:
             if having is not None:
                 having = resolve(having, df.schema)
                 for a in _collect_aggs(having):
-                    if repr(a) not in agg_map:
+                    if a.fingerprint() not in agg_map:
                         hn = f"__having_{len(hidden)}"
                         aggs.append(Column(Alias(a, hn)))
-                        agg_map[repr(a)] = hn
+                        agg_map[a.fingerprint()] = hn
                         hidden.append(hn)
             out = gd.agg(*aggs)
             if having is not None:
@@ -335,6 +365,9 @@ class Parser:
             for nm, e in post:
                 if e is None:
                     sel.append(Column(ColumnRef(nm)).alias(nm))
+                elif isinstance(e, tuple) and e[0] == "postagg":
+                    e2 = _replace_aggs(e[1], agg_map, key_map)
+                    sel.append(Column(e2).alias(nm))
                 else:
                     e2 = _replace_keys(e, key_map)
                     sel.append(Column(e2).alias(nm))
@@ -363,7 +396,9 @@ class Parser:
                 self.next()  # alias (single-namespace: names already unique)
             return sub
         name = self.expect("ident").value
-        df = self.session.table(name)
+        df = self.ctes.get(name.lower())
+        if df is None:
+            df = self.session.table(name)
         self.accept("keyword", "as")
         if self.peek().kind == "ident" and not self.at_kw():
             self.next()
@@ -880,16 +915,6 @@ def _contains_agg(e: Expression) -> bool:
     return any(_contains_agg(c) for c in e.children)
 
 
-def _extract_single_agg(e: Expression):
-    """Each aggregate projection must BE an aggregate call; post-agg
-    arithmetic over aggregates is expressed via subqueries for now."""
-    if isinstance(e, A.AggregateFunction):
-        return e
-    raise SyntaxError(
-        "aggregate expressions must be plain aggregate calls in this "
-        f"version: {e!r}")
-
-
 def _collect_aggs(e: Expression):
     if isinstance(e, A.AggregateFunction):
         return [e]
@@ -901,9 +926,9 @@ def _collect_aggs(e: Expression):
 
 def _replace_aggs(e: Expression, agg_map, key_map) -> Expression:
     if isinstance(e, A.AggregateFunction):
-        return ColumnRef(agg_map[repr(e)])
-    if repr(e) in key_map:
-        return ColumnRef(key_map[repr(e)])
+        return ColumnRef(agg_map[e.fingerprint()])
+    if e.fingerprint() in key_map:
+        return ColumnRef(key_map[e.fingerprint()])
     new_children = [_replace_aggs(c, agg_map, key_map) for c in e.children]
     if new_children and any(a is not b for a, b in
                             zip(new_children, e.children)):
@@ -912,8 +937,8 @@ def _replace_aggs(e: Expression, agg_map, key_map) -> Expression:
 
 
 def _replace_keys(e: Expression, key_map) -> Expression:
-    if repr(e) in key_map:
-        return ColumnRef(key_map[repr(e)])
+    if e.fingerprint() in key_map:
+        return ColumnRef(key_map[e.fingerprint()])
     new_children = [_replace_keys(c, key_map) for c in e.children]
     if new_children and any(a is not b for a, b in
                             zip(new_children, e.children)):
@@ -930,7 +955,7 @@ def _default_name(e: Expression, idx: int) -> str:
 
 
 def parse_sql(sql: str, session):
-    return Parser(tokenize(sql), session).parse_query()
+    return Parser(tokenize(sql), session).parse_statement()
 
 
 def parse_expression(text: str) -> Expression:

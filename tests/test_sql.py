@@ -109,3 +109,48 @@ def test_sql_count_distinct():
             "       SUM(k) AS sk "
             "FROM cd GROUP BY k")
     assert_tpu_cpu_equal(q)
+
+
+class TestFingerprintDedup:
+    """Round-5 review regressions: dedup maps must key on structural
+    fingerprints, not repr (repr omits frames/offsets/parameters)."""
+
+    def test_asc_and_desc_rank_windows_are_distinct(self):
+        from compare import assert_tpu_cpu_equal
+
+        def q(s):
+            df = s.create_dataframe(
+                {"g": ["a", "a", "b", "b"], "x": [1, 2, 3, 4]},
+                num_partitions=1)
+            s.register_view("t_fp", df)
+            return s.sql(
+                "SELECT x, rank() OVER (ORDER BY x ASC) AS r_up, "
+                "rank() OVER (ORDER BY x DESC) AS r_down FROM t_fp")
+
+        assert_tpu_cpu_equal(q)
+
+    def test_lag_offsets_are_distinct(self):
+        from compare import assert_tpu_cpu_equal
+
+        def q(s):
+            df = s.create_dataframe(
+                {"g": ["a", "a", "a", "a"], "x": [1, 2, 3, 4]},
+                num_partitions=1)
+            s.register_view("t_fp2", df)
+            return s.sql(
+                "SELECT x, lag(x, 1) OVER (PARTITION BY g ORDER BY x) "
+                "AS l1, lag(x, 2) OVER (PARTITION BY g ORDER BY x) AS l2 "
+                "FROM t_fp2")
+
+        assert_tpu_cpu_equal(q)
+
+    def test_percentile_spread_not_collapsed(self):
+        from compare import cpu_session
+        s = cpu_session()
+        df = s.create_dataframe({"x": [1.0, 2.0, 3.0, 4.0, 5.0]},
+                                num_partitions=1)
+        s.register_view("t_fp3", df)
+        rows = s.sql(
+            "SELECT percentile(x, 0.9) - percentile(x, 0.1) AS spread "
+            "FROM t_fp3").collect()
+        assert abs(rows[0][0] - 3.2) < 1e-9, rows
