@@ -32,7 +32,12 @@ import numpy as np
 
 ROWS = int(os.environ.get("BENCH_ROWS", 1 << 24))
 # 16M rows default — large enough that per-dispatch round-trip
-PARTS = 4  # latency (~100ms over the tunneled chip) amortizes
+# latency (~100ms over the tunneled chip) amortizes.
+# ONE batch per chip by default: the reference's steady state is a few
+# multi-hundred-MB batches per GPU (2GB target batch size); 16M rows x
+# 26B ~= 416MB matches that shape, and every extra partition costs a
+# full dispatch round-trip over the tunnel.
+PARTS = int(os.environ.get("BENCH_PARTS", "1"))
 
 # BENCH_PLATFORM forces a platform for smoke tests (sitecustomize pins
 # JAX_PLATFORMS=axon, so only jax.config.update can override it).

@@ -1,0 +1,90 @@
+"""Pallas vs XLA string-contains at 1M rows (VERDICT r4 item 8: "a
+measured win or a documented finding that XLA is already at parity").
+
+    python -m spark_rapids_tpu.benchmarks.pallas_strings_bench [--rows N]
+
+Builds a 1M-row string column (12-byte average), times the XLA
+formulation (exprs.strings._rows_with_match's gather+searchsorted path)
+against the Pallas one-pass kernel on the current backend, verifies they
+agree, and prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+import numpy as np
+
+
+def run(rows: int, needle: str = "acme") -> dict:
+    # build inputs BEFORE flipping the env: the XLA path must not take
+    # the Pallas branch
+    os.environ["SPARK_RAPIDS_PALLAS_STRINGS"] = "0"
+    import jax
+    import jax.numpy as jnp
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.batch import HostBatch, host_to_device
+    from spark_rapids_tpu.exprs.base import DevVal
+    from spark_rapids_tpu.exprs import strings as S
+    from spark_rapids_tpu.kernels import pallas_strings as PS
+
+    rng = np.random.RandomState(3)
+    frags = np.array(["acme", "corp", "ax", "me", "xyzzy", "ac", "cme",
+                      "a", ""])
+    strs = ["".join(rng.choice(frags, rng.randint(1, 5)))
+            for _ in range(rows)]
+    hb = HostBatch.from_pydict({"s": (T.STRING, strs)})
+    db = host_to_device(hb)
+    col = db.columns[0]
+    v = DevVal(col.dtype, col.data, col.validity, col.offsets)
+    nb = needle.encode()
+
+    xla_fn = jax.jit(lambda d, o, val: S._rows_with_match(
+        DevVal(col.dtype, d, val, o), nb))
+    pal_fn = jax.jit(lambda d, o, val: PS.rows_with_match(
+        d, o, val, v.capacity, nb))
+
+    def best_of(fn, n=5):
+        out = fn(v.data, v.offsets, v.validity)
+        jax.block_until_ready(out)  # compile + warm
+        best = float("inf")
+        for _ in range(n):
+            t0 = time.monotonic()
+            jax.block_until_ready(fn(v.data, v.offsets, v.validity))
+            best = min(best, time.monotonic() - t0)
+        return best, out
+
+    t_xla, r_xla = best_of(xla_fn)
+    os.environ["SPARK_RAPIDS_PALLAS_STRINGS"] = "1"
+    t_pal, r_pal = best_of(pal_fn)
+
+    agree = bool(np.array_equal(np.asarray(r_xla)[:rows],
+                                np.asarray(r_pal)[:rows]))
+    nbytes = int(col.data.shape[0])
+    return {
+        "metric": "contains_1m",
+        "rows": rows, "byte_buffer": nbytes,
+        "backend": jax.default_backend(),
+        "xla_s": round(t_xla, 5), "pallas_s": round(t_pal, 5),
+        "speedup_pallas_vs_xla": round(t_xla / t_pal, 3),
+        "xla_gb_per_sec": round(nbytes / t_xla / 1e9, 3),
+        "pallas_gb_per_sec": round(nbytes / t_pal / 1e9, 3),
+        "agree": agree,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1_000_000)
+    a = ap.parse_args(argv)
+    res = run(a.rows)
+    print(json.dumps(res))
+    assert res["agree"], "pallas and xla disagree"
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -216,6 +216,10 @@ def gen_catalog_sales(sf: float, seed: int = 29) -> Dict:
         "cs_ext_sales_price": (T.DOUBLE, (price * qty).round(2)),
         "cs_ext_discount_amt": (T.DOUBLE, (r.rand(n) * 120).round(2)),
         "cs_net_profit": (T.DOUBLE, ((r.rand(n) - 0.3) * 600).round(2)),
+        # drawn LAST so earlier columns keep their values across versions;
+        # some orders genuinely ship from several warehouses (q16's
+        # multi-warehouse EXISTS shape)
+        "cs_warehouse_sk": (T.LONG, r.randint(1, 7, n)),
     }
 
 
@@ -238,6 +242,8 @@ def gen_web_sales(sf: float, seed: int = 30) -> Dict:
         "ws_sales_price": (T.DOUBLE, price),
         "ws_ext_sales_price": (T.DOUBLE, (price * qty).round(2)),
         "ws_net_profit": (T.DOUBLE, ((r.rand(n) - 0.25) * 400).round(2)),
+        # drawn last (see cs_warehouse_sk); q95's multi-warehouse orders
+        "ws_warehouse_sk": (T.LONG, r.randint(1, 7, n)),
     }
 
 
@@ -290,6 +296,36 @@ def gen_catalog_returns(sf: float, seed: int = 32, sales: Dict = None) -> Dict:
     }
 
 
+def gen_warehouse(seed: int = 33) -> Dict:
+    n = 6
+    r = np.random.RandomState(seed)
+    return {
+        "w_warehouse_sk": (T.LONG, np.arange(1, n + 1)),
+        "w_warehouse_name": (T.STRING,
+                             np.array([f"Warehouse#{i}"
+                                       for i in range(1, n + 1)])),
+        "w_state": (T.STRING, r.choice(STATES, n)),
+    }
+
+
+def gen_inventory(sf: float, seed: int = 34) -> Dict:
+    """Weekly stock snapshots (inventory role): random (date, item,
+    warehouse) observations rather than the full cross product, sized to
+    stay proportional to the fact tables."""
+    n = max(200, int(sf * 30_000))
+    r = np.random.RandomState(seed)
+    n_item = max(10, int(sf * 2_000))
+    # snapshot dates on week boundaries across both years
+    dates = np.arange(7, 731, 7)
+    return {
+        "inv_date_sk": (T.LONG, r.choice(dates, n)),
+        "inv_item_sk": (T.LONG, r.randint(1, n_item + 1, n)),
+        "inv_warehouse_sk": (T.LONG, r.randint(1, 7, n)),
+        "inv_quantity_on_hand": (T.INT,
+                                 r.randint(0, 1000, n).astype(np.int32)),
+    }
+
+
 def build_tables(sf: float) -> Dict[str, Dict]:
     """All tables at one scale; the sales facts are generated once and
     fed to their returns generators (they sample sale lines)."""
@@ -310,6 +346,8 @@ def build_tables(sf: float) -> Dict[str, Dict]:
         "date_dim": gen_date_dim(),
         "store": gen_store(),
         "promotion": gen_promotion(),
+        "warehouse": gen_warehouse(),
+        "inventory": gen_inventory(sf),
     }
 
 
@@ -1843,6 +1881,434 @@ FROM store_sales
 """
 
 
+# -- round-5 wave 2: the 18 queries closing the reference's 103-query list
+# (tpcds_test.py:21-50) -------------------------------------------------
+
+Q6 = """
+SELECT c_state, count(*) AS cnt
+FROM store_sales
+JOIN customer ON c_customer_sk = ss_customer_sk
+JOIN item ON i_item_sk = ss_item_sk
+JOIN date_dim ON d_date_sk = ss_sold_date_sk
+JOIN (
+  SELECT i_category AS cat, avg(i_current_price) AS avg_price
+  FROM item GROUP BY i_category
+) a ON i_category = cat
+WHERE d_year = 1998 AND d_moy = 1 AND i_current_price > 1.2 * avg_price
+GROUP BY c_state
+HAVING count(*) >= 10
+ORDER BY cnt, c_state
+LIMIT 100
+"""
+
+Q14A = """
+WITH cross_items AS (
+  SELECT ss_item_sk AS ci_item_sk FROM store_sales
+  INTERSECT
+  SELECT cs_item_sk FROM catalog_sales
+  INTERSECT
+  SELECT ws_item_sk FROM web_sales),
+avg_sales AS (
+  SELECT avg(q * p) AS average_sales FROM (
+    SELECT ss_quantity AS q, ss_sales_price AS p FROM store_sales
+    UNION ALL
+    SELECT cs_quantity, cs_sales_price FROM catalog_sales
+    UNION ALL
+    SELECT ws_quantity, ws_sales_price FROM web_sales))
+SELECT channel, i_brand, sum_sales
+FROM (
+  SELECT channel, i_brand, sum(sales) AS sum_sales
+  FROM (
+    SELECT 'store' AS channel, i_brand,
+           ss_quantity * ss_sales_price AS sales
+    FROM store_sales
+    JOIN item ON i_item_sk = ss_item_sk
+    LEFT SEMI JOIN cross_items ON ci_item_sk = ss_item_sk
+    UNION ALL
+    SELECT 'catalog' AS channel, i_brand,
+           cs_quantity * cs_sales_price AS sales
+    FROM catalog_sales
+    JOIN item ON i_item_sk = cs_item_sk
+    LEFT SEMI JOIN cross_items ON ci_item_sk = cs_item_sk
+    UNION ALL
+    SELECT 'web' AS channel, i_brand,
+           ws_quantity * ws_sales_price AS sales
+    FROM web_sales
+    JOIN item ON i_item_sk = ws_item_sk
+    LEFT SEMI JOIN cross_items ON ci_item_sk = ws_item_sk
+  )
+  GROUP BY channel, i_brand
+) CROSS JOIN avg_sales
+WHERE sum_sales > average_sales
+ORDER BY channel, i_brand
+LIMIT 100
+"""
+
+Q14B = """
+WITH cross_items AS (
+  SELECT ss_item_sk AS ci_item_sk FROM store_sales
+  INTERSECT
+  SELECT cs_item_sk FROM catalog_sales
+  INTERSECT
+  SELECT ws_item_sk FROM web_sales)
+SELECT ty.i_brand, ty_sales, ly_sales, ty_sales / ly_sales AS growth
+FROM (
+  SELECT i_brand, sum(ss_quantity * ss_sales_price) AS ty_sales
+  FROM store_sales
+  JOIN item ON i_item_sk = ss_item_sk
+  JOIN date_dim ON d_date_sk = ss_sold_date_sk
+  LEFT SEMI JOIN cross_items ON ci_item_sk = ss_item_sk
+  WHERE d_year = 1999
+  GROUP BY i_brand
+) ty
+JOIN (
+  SELECT i_brand AS ly_brand,
+         sum(ss_quantity * ss_sales_price) AS ly_sales
+  FROM store_sales
+  JOIN item ON i_item_sk = ss_item_sk
+  JOIN date_dim ON d_date_sk = ss_sold_date_sk
+  LEFT SEMI JOIN cross_items ON ci_item_sk = ss_item_sk
+  WHERE d_year = 1998
+  GROUP BY i_brand
+) ly ON ly_brand = ty.i_brand
+WHERE ly_sales > 0
+ORDER BY ty.i_brand
+LIMIT 100
+"""
+
+Q16 = """
+SELECT count(DISTINCT cs_order_number) AS order_count,
+       sum(cs_ext_sales_price) AS total_shipping_cost,
+       sum(cs_net_profit) AS total_net_profit
+FROM catalog_sales
+LEFT ANTI JOIN catalog_returns ON cr_order_number = cs_order_number
+LEFT SEMI JOIN (
+  SELECT multi_wh_order FROM (
+    SELECT cs_order_number AS multi_wh_order, cs_warehouse_sk
+    FROM catalog_sales
+    GROUP BY cs_order_number, cs_warehouse_sk
+  )
+  GROUP BY multi_wh_order
+  HAVING count(*) > 1
+) mw ON multi_wh_order = cs_order_number
+JOIN date_dim ON d_date_sk = cs_sold_date_sk
+WHERE d_year = 1998 AND d_moy BETWEEN 2 AND 4
+"""
+
+Q18 = """
+SELECT i_category, c_state,
+       avg(cs_quantity) AS agg1,
+       avg(cs_sales_price) AS agg2,
+       avg(cs_ext_sales_price) AS agg3,
+       avg(cs_net_profit) AS agg4
+FROM catalog_sales
+JOIN item ON i_item_sk = cs_item_sk
+JOIN customer ON c_customer_sk = cs_bill_customer_sk
+JOIN date_dim ON d_date_sk = cs_sold_date_sk
+WHERE d_year = 1998
+GROUP BY ROLLUP(i_category, c_state)
+ORDER BY i_category, c_state
+LIMIT 100
+"""
+
+Q21 = """
+SELECT *
+FROM (
+  SELECT w_warehouse_name, inv_item_sk,
+         sum(CASE WHEN d_date_sk < 365
+                  THEN inv_quantity_on_hand ELSE 0 END) AS inv_before,
+         sum(CASE WHEN d_date_sk >= 365
+                  THEN inv_quantity_on_hand ELSE 0 END) AS inv_after
+  FROM inventory
+  JOIN warehouse ON w_warehouse_sk = inv_warehouse_sk
+  JOIN date_dim ON d_date_sk = inv_date_sk
+  GROUP BY w_warehouse_name, inv_item_sk
+)
+WHERE inv_before > 0
+  AND inv_after / inv_before >= 0.666
+  AND inv_after / inv_before <= 1.5
+ORDER BY w_warehouse_name, inv_item_sk
+LIMIT 100
+"""
+
+Q24A = """
+WITH ssales AS (
+  SELECT c_customer_sk AS cust, s_store_sk AS store_sk,
+         i_item_sk AS item_sk, sum(ss_sales_price) AS netpaid
+  FROM store_sales
+  JOIN store ON s_store_sk = ss_store_sk
+  JOIN item ON i_item_sk = ss_item_sk
+  JOIN customer ON c_customer_sk = ss_customer_sk
+  WHERE i_category = 'Jewelry'
+  GROUP BY c_customer_sk, s_store_sk, i_item_sk)
+SELECT cust, paid
+FROM (
+  SELECT cust, sum(netpaid) AS paid FROM ssales GROUP BY cust
+) CROSS JOIN (
+  SELECT 0.05 * avg(netpaid) AS thr FROM ssales
+) t
+WHERE paid > thr
+ORDER BY cust
+LIMIT 100
+"""
+
+Q24B = """
+WITH ssales AS (
+  SELECT c_customer_sk AS cust, s_store_sk AS store_sk,
+         i_item_sk AS item_sk, sum(ss_sales_price) AS netpaid
+  FROM store_sales
+  JOIN store ON s_store_sk = ss_store_sk
+  JOIN item ON i_item_sk = ss_item_sk
+  JOIN customer ON c_customer_sk = ss_customer_sk
+  WHERE i_category = 'Electronics'
+  GROUP BY c_customer_sk, s_store_sk, i_item_sk)
+SELECT cust, paid
+FROM (
+  SELECT cust, sum(netpaid) AS paid FROM ssales GROUP BY cust
+) CROSS JOIN (
+  SELECT 0.05 * avg(netpaid) AS thr FROM ssales
+) t
+WHERE paid > thr
+ORDER BY cust
+LIMIT 100
+"""
+
+Q32 = """
+WITH avg_disc AS (
+  SELECT cs_item_sk AS ad_item,
+         1.3 * avg(cs_ext_discount_amt) AS thr
+  FROM catalog_sales
+  JOIN date_dim ON d_date_sk = cs_sold_date_sk
+  WHERE d_year = 1998
+  GROUP BY cs_item_sk)
+SELECT sum(cs_ext_discount_amt) AS excess_discount
+FROM catalog_sales
+JOIN avg_disc ON ad_item = cs_item_sk
+JOIN date_dim ON d_date_sk = cs_sold_date_sk
+WHERE d_year = 1998 AND cs_ext_discount_amt > thr
+"""
+
+Q58 = """
+WITH ss_items AS (
+  SELECT ss_item_sk AS s_item, sum(ss_ext_sales_price) AS ss_rev
+  FROM store_sales
+  JOIN date_dim ON d_date_sk = ss_sold_date_sk
+  WHERE d_moy = 3 GROUP BY ss_item_sk),
+cs_items AS (
+  SELECT cs_item_sk AS c_item, sum(cs_ext_sales_price) AS cs_rev
+  FROM catalog_sales
+  JOIN date_dim ON d_date_sk = cs_sold_date_sk
+  WHERE d_moy = 3 GROUP BY cs_item_sk),
+ws_items AS (
+  SELECT ws_item_sk AS w_item, sum(ws_ext_sales_price) AS ws_rev
+  FROM web_sales
+  JOIN date_dim ON d_date_sk = ws_sold_date_sk
+  WHERE d_moy = 3 GROUP BY ws_item_sk)
+SELECT s_item, ss_rev, cs_rev, ws_rev,
+       (ss_rev + cs_rev + ws_rev) / 3 AS average
+FROM ss_items
+JOIN cs_items ON c_item = s_item
+JOIN ws_items ON w_item = s_item
+WHERE ss_rev >= 0.9 * cs_rev AND ss_rev <= 1.1 * cs_rev
+  AND ss_rev >= 0.9 * ws_rev AND ss_rev <= 1.1 * ws_rev
+ORDER BY s_item
+LIMIT 100
+"""
+
+Q70 = """
+SELECT total_sum, s_state, ranking
+FROM (
+  SELECT s_state, sum(ss_net_profit) AS total_sum,
+         rank() OVER (ORDER BY sum(ss_net_profit) DESC) AS ranking
+  FROM store_sales
+  JOIN store ON s_store_sk = ss_store_sk
+  JOIN date_dim ON d_date_sk = ss_sold_date_sk
+  WHERE d_year = 1998
+  GROUP BY s_state
+)
+ORDER BY ranking, s_state
+"""
+
+Q72 = """
+SELECT i_item_sk AS item_sk, w_warehouse_name, d_week_seq,
+       count(*) AS low_stock_cnt
+FROM catalog_sales
+JOIN inventory ON inv_item_sk = cs_item_sk
+JOIN warehouse ON w_warehouse_sk = inv_warehouse_sk
+JOIN item ON i_item_sk = cs_item_sk
+JOIN date_dim ON d_date_sk = cs_sold_date_sk
+WHERE inv_quantity_on_hand < cs_quantity AND d_year = 1998
+GROUP BY i_item_sk, w_warehouse_name, d_week_seq
+ORDER BY low_stock_cnt DESC, i_item_sk, w_warehouse_name, d_week_seq
+LIMIT 100
+"""
+
+Q77 = """
+WITH ss AS (
+  SELECT ss_store_sk AS store_id, sum(ss_ext_sales_price) AS sales,
+         sum(ss_net_profit) AS profit
+  FROM store_sales
+  JOIN date_dim ON d_date_sk = ss_sold_date_sk
+  WHERE d_year = 1998 GROUP BY ss_store_sk),
+sr AS (
+  SELECT sr_store_sk AS ret_store_id, sum(sr_return_amt) AS ret
+  FROM store_returns GROUP BY sr_store_sk),
+cs AS (
+  SELECT sum(cs_ext_sales_price) AS sales, sum(cs_net_profit) AS profit
+  FROM catalog_sales
+  JOIN date_dim ON d_date_sk = cs_sold_date_sk
+  WHERE d_year = 1998),
+cr AS (
+  SELECT sum(cr_return_amount) AS ret FROM catalog_returns),
+ws AS (
+  SELECT ws_warehouse_sk AS wh_id, sum(ws_ext_sales_price) AS sales,
+         sum(ws_net_profit) AS profit
+  FROM web_sales
+  JOIN date_dim ON d_date_sk = ws_sold_date_sk
+  WHERE d_year = 1998 GROUP BY ws_warehouse_sk),
+wr AS (
+  SELECT ws_warehouse_sk AS ret_wh_id, sum(wr_return_amt) AS ret
+  FROM web_returns
+  JOIN web_sales ON ws_order_number = wr_order_number
+                AND ws_item_sk = wr_item_sk
+  GROUP BY ws_warehouse_sk)
+SELECT channel, id, sum(sales) AS sales, sum(ret) AS ret,
+       sum(profit) AS profit
+FROM (
+  SELECT 'store channel' AS channel, store_id AS id, sales,
+         coalesce(ret, 0.0) AS ret, profit
+  FROM ss LEFT JOIN sr ON ret_store_id = store_id
+  UNION ALL
+  SELECT 'catalog channel' AS channel, 0 AS id, sales, ret, profit
+  FROM cs CROSS JOIN cr
+  UNION ALL
+  SELECT 'web channel' AS channel, wh_id AS id, sales,
+         coalesce(ret, 0.0) AS ret, profit
+  FROM ws LEFT JOIN wr ON ret_wh_id = wh_id
+)
+GROUP BY ROLLUP(channel, id)
+ORDER BY channel, id
+LIMIT 100
+"""
+
+Q80 = """
+WITH ssr AS (
+  SELECT s_store_sk AS id, sum(ss_ext_sales_price) AS sales,
+         sum(coalesce(sr_return_amt, 0.0)) AS ret,
+         sum(ss_net_profit) AS profit
+  FROM store_sales
+  LEFT JOIN store_returns ON sr_item_sk = ss_item_sk
+                         AND sr_ticket_number = ss_ticket_number
+  JOIN date_dim ON d_date_sk = ss_sold_date_sk
+  JOIN store ON s_store_sk = ss_store_sk
+  WHERE d_year = 1998
+  GROUP BY s_store_sk),
+csr AS (
+  SELECT cs_warehouse_sk AS id, sum(cs_ext_sales_price) AS sales,
+         sum(coalesce(cr_return_amount, 0.0)) AS ret,
+         sum(cs_net_profit) AS profit
+  FROM catalog_sales
+  LEFT JOIN catalog_returns ON cr_item_sk = cs_item_sk
+                           AND cr_order_number = cs_order_number
+  JOIN date_dim ON d_date_sk = cs_sold_date_sk
+  WHERE d_year = 1998
+  GROUP BY cs_warehouse_sk),
+wsr AS (
+  SELECT ws_warehouse_sk AS id, sum(ws_ext_sales_price) AS sales,
+         sum(coalesce(wr_return_amt, 0.0)) AS ret,
+         sum(ws_net_profit) AS profit
+  FROM web_sales
+  LEFT JOIN web_returns ON wr_item_sk = ws_item_sk
+                       AND wr_order_number = ws_order_number
+  JOIN date_dim ON d_date_sk = ws_sold_date_sk
+  WHERE d_year = 1998
+  GROUP BY ws_warehouse_sk)
+SELECT channel, id, sum(sales) AS sales, sum(ret) AS ret,
+       sum(profit) AS profit
+FROM (
+  SELECT 'store channel' AS channel, id, sales, ret, profit FROM ssr
+  UNION ALL
+  SELECT 'catalog channel' AS channel, id, sales, ret, profit FROM csr
+  UNION ALL
+  SELECT 'web channel' AS channel, id, sales, ret, profit FROM wsr
+)
+GROUP BY ROLLUP(channel, id)
+ORDER BY channel, id
+LIMIT 100
+"""
+
+Q83 = """
+WITH sr AS (
+  SELECT sr_item_sk AS s_item, sum(sr_return_quantity) AS sr_qty
+  FROM store_returns
+  JOIN date_dim ON d_date_sk = sr_returned_date_sk
+  WHERE d_moy BETWEEN 6 AND 8 GROUP BY sr_item_sk),
+cr AS (
+  SELECT cr_item_sk AS c_item, sum(cr_return_quantity) AS cr_qty
+  FROM catalog_returns
+  JOIN date_dim ON d_date_sk = cr_returned_date_sk
+  WHERE d_moy BETWEEN 6 AND 8 GROUP BY cr_item_sk),
+wr AS (
+  SELECT wr_item_sk AS w_item, sum(wr_return_quantity) AS wr_qty
+  FROM web_returns
+  JOIN date_dim ON d_date_sk = wr_returned_date_sk
+  WHERE d_moy BETWEEN 6 AND 8 GROUP BY wr_item_sk)
+SELECT s_item, sr_qty, cr_qty, wr_qty,
+       sr_qty + cr_qty + wr_qty AS total_qty
+FROM sr
+JOIN cr ON c_item = s_item
+JOIN wr ON w_item = s_item
+ORDER BY s_item
+LIMIT 100
+"""
+
+Q84 = """
+SELECT c_customer_sk, c_first_name, count(*) AS cnt
+FROM store_returns
+JOIN customer ON c_customer_sk = sr_customer_sk
+JOIN customer_address ON ca_address_sk = c_current_addr_sk
+JOIN household_demographics ON hd_demo_sk = c_current_hdemo_sk
+WHERE ca_city = 'Midway' AND hd_dep_count >= 3
+GROUP BY c_customer_sk, c_first_name
+ORDER BY c_customer_sk
+LIMIT 100
+"""
+
+Q86 = """
+SELECT i_category, i_class, total_sum,
+       rank() OVER (PARTITION BY i_category
+                    ORDER BY total_sum DESC) AS rank_within
+FROM (
+  SELECT i_category, i_class, sum(ws_net_profit) AS total_sum
+  FROM web_sales
+  JOIN item ON i_item_sk = ws_item_sk
+  JOIN date_dim ON d_date_sk = ws_sold_date_sk
+  WHERE d_year = 1998
+  GROUP BY ROLLUP(i_category, i_class)
+)
+ORDER BY i_category, i_class, rank_within
+LIMIT 100
+"""
+
+Q95 = """
+WITH ws_wh AS (
+  SELECT wh_order FROM (
+    SELECT ws_order_number AS wh_order, ws_warehouse_sk
+    FROM web_sales
+    GROUP BY ws_order_number, ws_warehouse_sk
+  )
+  GROUP BY wh_order
+  HAVING count(*) > 1)
+SELECT count(DISTINCT ws_order_number) AS order_count,
+       sum(ws_ext_sales_price) AS total_shipping_cost,
+       sum(ws_net_profit) AS total_net_profit
+FROM web_sales
+LEFT SEMI JOIN ws_wh ON wh_order = ws_order_number
+LEFT SEMI JOIN web_returns ON wr_order_number = ws_order_number
+JOIN date_dim ON d_date_sk = ws_sold_date_sk
+WHERE d_year = 1998
+"""
+
 QUERIES = {"q3": Q3, "q7": Q7, "q13": Q13, "q14": Q14, "q19": Q19,
            "q26": Q26, "q29": Q29, "q36": Q36, "q42": Q42, "q43": Q43,
            "q48": Q48, "q52": Q52, "q53": Q53, "q55": Q55, "q59": Q59,
@@ -1863,4 +2329,9 @@ QUERIES = {"q3": Q3, "q7": Q7, "q13": Q13, "q14": Q14, "q19": Q19,
            "q66": Q66, "q69": Q69, "q71": Q71, "q74": Q74, "q75": Q75,
            "q76": Q76, "q78": Q78, "q81": Q81, "q82": Q82, "q85": Q85,
            "q88": Q88, "q90": Q90, "q91": Q91, "q94": Q94, "q96": Q96,
-           "q97": Q97, "q99": Q99, "ss_max": SS_MAX}
+           "q97": Q97, "q99": Q99, "ss_max": SS_MAX,
+           # round-5 wave 2: the final 18 of the reference's 103-query list
+           "q6": Q6, "q14a": Q14A, "q14b": Q14B, "q16": Q16, "q18": Q18,
+           "q21": Q21, "q24a": Q24A, "q24b": Q24B, "q32": Q32,
+           "q58": Q58, "q70": Q70, "q72": Q72, "q77": Q77, "q80": Q80,
+           "q83": Q83, "q84": Q84, "q86": Q86, "q95": Q95}

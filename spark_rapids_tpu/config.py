@@ -257,6 +257,12 @@ MULTITHREADED_READ_THREADS = conf_int(
     "spark.rapids.sql.format.parquet.multiThreadedRead.numThreads", 8,
     "Threads used to read+decode file footers and column chunks in "
     "parallel ahead of device staging.")
+STAGE_READAHEAD_BATCHES = conf_int(
+    "spark.rapids.sql.tpu.stage.readAheadBatches", 2,
+    "Host batches decoded AND staged into HBM ahead of the consumer by a "
+    "background thread, so scan decode + host->device transfer overlap "
+    "downstream device compute (the reference's read-ahead + semaphore "
+    "pattern, GpuParquetScan.scala:647-700).  0 = synchronous staging.")
 PARQUET_ENABLED = conf_bool(
     "spark.rapids.sql.format.parquet.enabled", True,
     "Enable TPU-accelerated parquet scans.")

@@ -162,6 +162,17 @@ def _find_matches(v: DevVal, needle: bytes):
 
 def _rows_with_match(v: DevVal, needle: bytes):
     cap = v.capacity
+    if len(needle) > 0:
+        # Pallas one-pass scan on real TPU backends (the reference's
+        # dedicated contains kernel role); XLA formulation everywhere
+        # else and as the fallback if the kernel fails to lower.
+        from spark_rapids_tpu.kernels import pallas_strings as PS
+        if PS.use_pallas_strings():
+            try:
+                return PS.rows_with_match(
+                    v.data, v.offsets, v.validity, cap, needle)
+            except Exception:
+                pass
     match = _find_matches(v, needle)
     nbytes = int(v.data.shape[0])
     rows = jnp.clip(rows_of_positions(v.offsets, nbytes), 0, cap - 1)

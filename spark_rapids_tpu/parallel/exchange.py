@@ -226,10 +226,23 @@ class TpuShuffleExchangeExec(TpuExec):
             pid = part.device_partition_ids(merged, d)
             local_batches.append(merged)
             pids_list.append(jnp.asarray(pid, jnp.int32))
+        import time as _time
+        stats: dict = {}
+        t0 = _time.monotonic_ns()
         out = mesh_exchange_batches(mesh, local_batches, pids_list,
-                                    self.output_schema)
+                                    self.output_schema, stats=stats)
+        if out:
+            jax.block_until_ready(out)
+        wall_ns = _time.monotonic_ns() - t0
         ctx.metric(self.op_id, "meshExchanges").add(1)
         ctx.metric(self.op_id, "meshDevices").add(n)
+        # shuffle throughput accounting (RapidsCachingReader.scala:125-133
+        # role): bytes moved + wall time -> GB/s is derivable downstream
+        ctx.metric(self.op_id, "shuffleBytes").add(
+            stats.get("payload_bytes", 0))
+        ctx.metric(self.op_id, "shuffleWireBytes").add(
+            stats.get("wire_bytes", 0))
+        ctx.metric(self.op_id, "shuffleWallNs").add(wall_ns)
         return [iter([b]) for b in out] if out else \
             [iter([]) for _ in range(n)]
 
@@ -294,6 +307,8 @@ class TpuShuffleExchangeExec(TpuExec):
         frb = fixed_row_bytes(self.output_schema)
         vscales = varlen_byte_scales(self.output_schema)
         out: List[List] = [[] for _ in range(n)]
+        import time as _time
+        t0 = _time.monotonic_ns()
         for pi, batches in enumerate(all_batches):
             for db in batches:
                 sorted_batch, counts, byte_totals = \
@@ -334,6 +349,15 @@ class TpuShuffleExchangeExec(TpuExec):
         # map-status sizes)
         self._last_part_rows = [sum(h.piece_rows for h in p) for p in out]
         self._last_part_bytes = [sum(h.piece_bytes for h in p) for p in out]
+        # write-side shuffle metrics (single-host split path).  Wall time
+        # covers pid-sort + per-batch count syncs; the final batch's piece
+        # gathers may still be in flight (async dispatch), so this is a
+        # lower bound on split cost, not an upper
+        ctx.metric(self.op_id, "shuffleBytes").add(
+            sum(self._last_part_bytes))
+        ctx.metric(self.op_id, "shuffleRows").add(sum(self._last_part_rows))
+        ctx.metric(self.op_id, "shuffleWallNs").add(
+            _time.monotonic_ns() - t0)
         self._split_cache = (weakref.ref(ctx), out)
         return [self._drain_cached(p) for p in out]
 

@@ -392,7 +392,8 @@ def _cached(key, builder):
 
 
 def mesh_exchange_batches(mesh: Mesh, local_batches, pids_list,
-                          schema) -> List[ColumnBatch]:
+                          schema, stats: Optional[dict] = None
+                          ) -> List[ColumnBatch]:
     """Exchange rows of per-device batches so every row lands on the device
     its pid names — the engine's accelerated shuffle.
 
@@ -401,6 +402,14 @@ def mesh_exchange_batches(mesh: Mesh, local_batches, pids_list,
     Returns one ColumnBatch per device; every array in the outputs is a
     plain single-device array on its mesh device, and no payload buffer
     touches the host anywhere on this path.
+
+    ``stats`` (optional dict) receives byte accounting for the exchange —
+    the role of the reference's per-read shuffle metrics
+    (RapidsCachingReader.scala:125-133):
+      payload_bytes — LIVE rows x fixed row bytes + live varlen element
+                      bytes (what "shuffle bytes written" means upstream);
+      wire_bytes    — total size of the padded arrays the all_to_all
+                      actually moves (upper bound incl. bucket padding).
     """
     from spark_rapids_tpu.batch import round_up_capacity
     n = mesh.shape[DATA_AXIS]
@@ -428,6 +437,31 @@ def mesh_exchange_batches(mesh: Mesh, local_batches, pids_list,
             vi += 1
     out_cap = round_up_capacity(n * cap)
     out_ecaps = {ci: round_up_capacity(n * e) for ci, e in ecaps.items()}
+
+    if stats is not None:
+        from spark_rapids_tpu.batch import fixed_row_bytes, \
+            varlen_byte_scales
+        frb = fixed_row_bytes(schema)
+        vscales = varlen_byte_scales(schema)
+        payload = 0
+        for rows, totals in sizes:
+            payload += rows * frb + sum(
+                t * sc for t, sc in zip(totals, vscales))
+        stats["payload_bytes"] = payload
+        # wire arrays: per column, bucketed [n, cap] (or [n, ecap]) on each
+        # of n devices -> n x the packed global size, + counts
+        wire = 0
+        for ci, f in enumerate(schema.fields):
+            if sig[ci]:
+                edt = np.dtype(np.uint8) if f.dtype.is_string \
+                    else np.dtype(f.dtype.element.np_dtype)
+                wire += n * n * (ecaps[ci] * edt.itemsize  # elements
+                                 + cap * 4                 # lens
+                                 + cap * 1)                # validity
+            else:
+                itemsize = np.dtype(f.dtype.np_dtype).itemsize
+                wire += n * n * cap * (itemsize + 1)
+        stats["wire_bytes"] = wire
 
     sig_key = tuple((f.dtype, sig[ci]) for ci, f in enumerate(schema.fields))
     ecaps_t = tuple(ecaps.get(ci, 0) for ci in range(len(schema.fields)))

@@ -135,22 +135,104 @@ class HostToDeviceExec(TpuExec):
         return "HostToDevice"
 
     def partitions(self, ctx: ExecContext) -> List[Iterator]:
+        from spark_rapids_tpu.config import STAGE_READAHEAD_BATCHES
         child_parts = self.children[0].partitions(ctx)
         t_metric = ctx.metric(self.op_id, "stageTime")
+        depth = STAGE_READAHEAD_BATCHES.get(ctx.conf)
+
+        def stage(hb, catalog):
+            from spark_rapids_tpu.mem.catalog import run_with_oom_retry
+            t0 = time.monotonic()
+            if ctx.semaphore is not None:
+                ctx.semaphore.acquire()
+            db = run_with_oom_retry(
+                catalog, lambda: host_to_device(hb, device=ctx.device))
+            t_metric.add(time.monotonic() - t0)
+            return db
 
         def gen(part):
-            from spark_rapids_tpu.mem.catalog import run_with_oom_retry
             from spark_rapids_tpu.runtime.device import DeviceRuntime
             catalog = DeviceRuntime.get(ctx.conf).catalog
             for hb in part:
-                t0 = time.monotonic()
-                if ctx.semaphore is not None:
-                    ctx.semaphore.acquire()
-                yield run_with_oom_retry(
-                    catalog, lambda: host_to_device(hb, device=ctx.device))
-                t_metric.add(time.monotonic() - t0)
+                yield stage(hb, catalog)
 
-        return [gen(p) for p in child_parts]
+        def stage_nosem(hb, catalog):
+            # worker-thread variant: NO semaphore.  TpuSemaphore is
+            # re-entrant per THREAD with the held-depth in a
+            # thread-local, and the paired release happens on the main
+            # thread (DeviceToHostExec) — a worker-side acquire would
+            # leak its permit forever and deadlock the next partition's
+            # worker.  Admission is instead taken by the CONSUMER below
+            # before the batch is yielded downstream; the read-ahead
+            # transfer itself rides the catalog's OOM-retry.
+            from spark_rapids_tpu.mem.catalog import run_with_oom_retry
+            t0 = time.monotonic()
+            db = run_with_oom_retry(
+                catalog, lambda: host_to_device(hb, device=ctx.device))
+            t_metric.add(time.monotonic() - t0)
+            return db
+
+        def gen_pipelined(part):
+            # Read-ahead staging: a background thread pulls host batches
+            # (driving the scan's decode) and stages them into HBM up to
+            # ``depth`` ahead, so decode + H2D transfer overlap the
+            # consumer's device compute — the reference's read-ahead pool
+            # + semaphore shape (GpuParquetScan.scala:647-700) without a
+            # dedicated stream: jax dispatch is async, the thread only
+            # pays the host-side copy/transfer-enqueue cost.
+            import queue
+            import threading
+            from spark_rapids_tpu.runtime.device import DeviceRuntime
+            catalog = DeviceRuntime.get(ctx.conf).catalog
+            q: "queue.Queue" = queue.Queue(maxsize=depth)
+            stop = threading.Event()
+            DONE = object()
+
+            def worker():
+                try:
+                    for hb in part:
+                        if stop.is_set():
+                            return
+                        item = ("b", stage_nosem(hb, catalog))
+                        while not stop.is_set():
+                            try:
+                                q.put(item, timeout=0.25)
+                                break
+                            except queue.Full:
+                                continue
+                        else:
+                            return
+                    q.put(DONE)
+                except BaseException as e:  # surfaced on the consumer side
+                    q.put(("e", e))
+
+            t = threading.Thread(target=worker, daemon=True,
+                                 name="stage-readahead")
+            t.start()
+            try:
+                while True:
+                    item = q.get()
+                    if item is DONE:
+                        return
+                    kind, v = item
+                    if kind == "e":
+                        raise v
+                    # device admission on the CONSUMER (main) thread —
+                    # re-entrant there, and paired with DeviceToHostExec's
+                    # release on the same thread
+                    if ctx.semaphore is not None:
+                        ctx.semaphore.acquire()
+                    yield v
+            finally:
+                stop.set()
+                try:
+                    while True:
+                        q.get_nowait()
+                except queue.Empty:
+                    pass
+
+        mk = gen_pipelined if depth > 0 else gen
+        return [mk(p) for p in child_parts]
 
 
 class DeviceToHostExec(CpuExec):

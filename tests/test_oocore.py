@@ -1,0 +1,18 @@
+"""Out-of-core ring test: file-backed TPC-H q1/q18 under a spill budget
+far below the working set must still be green, with spills asserted
+nonzero (CI-scale twin of benchmarks/oocore_run.py; the SF10 artifact is
+BENCH_OOCORE.md)."""
+
+import pytest
+
+
+@pytest.mark.parametrize("qname", ["q1", "q18"])
+def test_oocore_query_under_tiny_budget(qname, tmp_path):
+    from spark_rapids_tpu.benchmarks import oocore_run
+
+    res = oocore_run.run(
+        sf=0.2, budget_mb=2, queries=[qname],
+        out_path=str(tmp_path / "oocore.md"))
+    r = res[qname]
+    assert r["agree"]
+    assert r["spilled_to_host"] + r["spilled_to_disk"] > 0

@@ -13,7 +13,7 @@ SF = 0.1
 # The reference runs its whole tpcds suite with variableFloatAgg on,
 # except q67/q70 (tpcds_test.py:21-50) — mirror that so float sums/avgs
 # genuinely run on the device plan instead of falling back.
-NO_VAR_AGG = {"q67"}
+NO_VAR_AGG = {"q67", "q70"}
 
 
 @pytest.mark.parametrize("qname", sorted(QUERIES.keys()))
