@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Regenerate docs/configs.md from the conf registry (the reference's
+``RapidsConf.main`` doc generator, RapidsConf.scala:717,814) — or, with
+``--check``, fail loudly when the committed doc is stale.
+
+Confs registered by lazily-imported modules (spill catalog, multihost,
+python worker, session) must be imported FIRST or their rows silently
+drop out of the doc — the same import list
+tests/test_api_parity.py::test_configs_docs_cover_full_registry uses.
+
+Usage:  python ci/gen_configs_doc.py [--check]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def full_registry_docs() -> str:
+    import spark_rapids_tpu.config as C
+    import spark_rapids_tpu.mem.catalog  # noqa: F401
+    import spark_rapids_tpu.parallel.multihost  # noqa: F401
+    import spark_rapids_tpu.runtime.python_worker  # noqa: F401
+    import spark_rapids_tpu.session  # noqa: F401
+    return C.generate_docs()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 if docs/configs.md is stale (CI gate)")
+    args = ap.parse_args(argv)
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "configs.md")
+    doc = full_registry_docs()
+    if args.check:
+        on_disk = open(path).read() if os.path.exists(path) else ""
+        if on_disk != doc:
+            sys.stderr.write(
+                "docs/configs.md is STALE — regenerate with "
+                "`python ci/gen_configs_doc.py`\n")
+            return 1
+        print("docs/configs.md is up to date")
+        return 0
+    with open(path, "w") as f:
+        f.write(doc)
+    print(f"wrote {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -17,6 +17,14 @@ import sys, jax
 print(sys.version.split()[0], "jax", jax.__version__)
 PY
 
+# Per-test wall clock bound (tests/conftest.py SIGALRM hook): a wedged
+# test (e.g. a leaked read-ahead worker blocking the next suite) FAILS
+# with a TimeoutError + traceback instead of hanging the whole run.
+export PYTEST_PER_TEST_TIMEOUT="${PYTEST_PER_TEST_TIMEOUT:-120}"
+
+echo "== docs/configs.md freshness"
+python ci/gen_configs_doc.py --check
+
 if [ "$MODE" = "quick" ]; then
   python -m pytest tests/test_kernels_layout.py tests/test_kernels_join.py \
       tests/test_exprs.py tests/test_e2e_basic.py -q

@@ -35,19 +35,60 @@ import numpy as np
 from spark_rapids_tpu import types as T
 
 MIN_CAPACITY = 8
+MIN_BYTE_CAPACITY = 16
+
+
+class BucketPolicy:
+    """THE shape-bucket policy: every capacity any exec ever bakes into a
+    compiled program comes from this one object, so compiled-shape
+    cardinality per schema is bounded by a single rule instead of drifting
+    per call site (the recompilation-economics lever from SURVEY.md
+    section 7; the ``compiledShapes`` metric proves the bound holds).
+
+    Buckets are powers of two: row capacities >= ``min_rows``, varlen
+    element/byte capacities >= ``min_bytes`` (strings ARE array<byte>, so
+    both varlen kinds share the byte floor).
+    """
+
+    def __init__(self, min_rows: int = MIN_CAPACITY,
+                 min_bytes: int = MIN_BYTE_CAPACITY):
+        self.min_rows = min_rows
+        self.min_bytes = min_bytes
+
+    @staticmethod
+    def quantize(n: int, minimum: int) -> int:
+        cap = max(int(minimum), 1)
+        n = max(int(n), 1)
+        while cap < n:
+            cap <<= 1
+        return cap
+
+    def rows(self, n: int) -> int:
+        """Row-capacity bucket for ``n`` live rows."""
+        return self.quantize(n, self.min_rows)
+
+    def elems(self, n: int) -> int:
+        """Varlen element/byte-capacity bucket for ``n`` elements."""
+        return self.quantize(n, self.min_bytes)
+
+    def hot_buckets(self, max_rows: int) -> List[int]:
+        """The full row-bucket ladder up to ``max_rows`` — the shape set
+        ``session.prewarm()`` compiles ahead of time."""
+        out, cap = [], self.rows(1)
+        while cap <= self.rows(max_rows):
+            out.append(cap)
+            cap <<= 1
+        return out
+
+
+#: Process-wide shared bucket policy (all exec inputs route through it).
+BUCKETS = BucketPolicy()
 
 
 def round_up_capacity(n: int, minimum: int = MIN_CAPACITY) -> int:
-    """Bucketed capacity: next power of two >= n (>= minimum).
-
-    Powers of two bound the number of distinct compiled shapes per schema to
-    log2(max_rows) — the recompilation-economics lever from SURVEY.md section 7.
-    """
-    cap = max(int(minimum), 1)
-    n = max(int(n), 1)
-    while cap < n:
-        cap <<= 1
-    return cap
+    """Bucketed capacity via the shared :data:`BUCKETS` policy: next power
+    of two >= n (>= minimum)."""
+    return BUCKETS.quantize(n, minimum)
 
 
 # --------------------------------------------------------------------------
@@ -298,7 +339,9 @@ def _array_host_to_buffers(dtype: T.ArrayType, values: np.ndarray,
     offsets = np.zeros(len(lists) + 1, dtype=np.int32)
     np.cumsum(lengths, out=offsets[1:])
     total = int(offsets[-1])
-    cap = round_up_capacity(max(total, 1), minimum=8)
+    # shared varlen bucket floor (strings and arrays ride one policy so a
+    # mixed suite compiles one ladder of element capacities, not two)
+    cap = BUCKETS.elems(total)
     data = np.zeros(cap, dtype=dtype.element.np_dtype)
     if total:
         flat = [e for x in lists for e in x]

@@ -51,11 +51,34 @@ def _session(tpu: bool, root: str, budget_bytes: int):
 
 
 def run(sf: float, budget_mb: int, queries, out_path: str) -> dict:
+    from spark_rapids_tpu.runtime.device import DeviceRuntime
+
+    # DeviceRuntime is a process singleton: without a reset the catalog
+    # keeps whatever spill budget the FIRST session of the process chose,
+    # and the tiny budget below is silently ignored (no spills -> the
+    # out-of-core assertion fails).  Reset before and after (the
+    # tests/test_mem.py pattern) so the budget binds here and nothing
+    # leaks into later sessions/tests.
+    DeviceRuntime.reset()
+    try:
+        return _run_inner(sf, budget_mb, queries, out_path)
+    finally:
+        DeviceRuntime.reset()
+
+
+def _run_inner(sf: float, budget_mb: int, queries, out_path: str) -> dict:
     from spark_rapids_tpu.benchmarks.tpch_like import QUERIES
+    from spark_rapids_tpu.runtime.device import DeviceRuntime
 
     root = generate_dataset(sf)
     budget = budget_mb << 20
+    # generate_dataset ran its own engine sessions, (re)claiming the
+    # DeviceRuntime singleton with a default budget — reset AFTER it so
+    # the tiny-budget session below actually constructs the catalog
+    DeviceRuntime.reset()
     tpu = _session(True, root, budget)
+    assert tpu.runtime.catalog.device_budget == budget, \
+        "spill budget did not bind (stale DeviceRuntime singleton?)"
     cpu = _session(False, root, budget)
     results = {}
     for qname in queries:

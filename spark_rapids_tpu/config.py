@@ -327,6 +327,43 @@ COALESCE_TARGET_ROWS = conf_int(
 UDF_COMPILER_ENABLED = conf_bool(
     "spark.rapids.sql.udfCompiler.enabled", False,
     "Compile python row UDFs into columnar expressions when possible.")
+PIPELINE_ENABLED = conf_bool(
+    "spark.rapids.sql.tpu.pipeline.enabled", True,
+    "Run all-TPU plan subtrees as whole-pipeline XLA programs (the "
+    "whole-stage-codegen analogue): O(1) dispatched programs per query "
+    "stage instead of one per operator per batch.")
+FUSION_ENABLED = conf_bool(
+    "spark.rapids.sql.fusion.enabled", True,
+    "Collapse chains of per-batch map operators (project/filter) into one "
+    "compiled program and absorb them into aggregate/sort/exchange "
+    "consumers (dispatch-count optimizer).")
+EXCHANGE_COLLAPSE_LOCAL = conf_bool(
+    "spark.rapids.sql.tpu.exchange.collapseLocal", True,
+    "Collapse shuffle exchanges to a single logical partition in "
+    "single-process execution: partitioning only constrains placement, "
+    "which one partition trivially satisfies, so the per-batch pid "
+    "compute + split is pure overhead on one device.")
+PIPELINE_FUSE_TAIL = conf_bool(
+    "spark.rapids.sql.tpu.pipeline.fuseTail.enabled", True,
+    "Fuse the stage-break re-bucketing gather into the consuming (tail) "
+    "stage program: the final merge-aggregate/sort/limit tail then runs "
+    "in one jitted dispatch instead of shrink + tail (lower dispatchCount "
+    "per query; the tail program is cached per shrunk-bucket signature).")
+PIPELINE_SHRINK_BYTES = conf_bytes(
+    "spark.rapids.sql.tpu.pipeline.shrinkBytes", 4 << 20,
+    "Padded stage outputs at or below this byte total skip the sizes "
+    "round-trip + re-bucketing gather at pipeline stage breaks.")
+COMPILE_CACHE_DIR = conf_str(
+    "spark.rapids.sql.tpu.compileCacheDir", "",
+    "Directory for JAX's persistent XLA compilation cache.  When set, "
+    "compiled executables survive the process so re-runs (and "
+    "session.prewarm()) skip recompilation; empty disables persistence.")
+METRICS_DETAIL = conf_bool(
+    "spark.rapids.sql.tpu.metrics.detailEnabled", False,
+    "Accurate device-time metrics: block on dispatched outputs so "
+    "deviceTimeNs/shuffleWallNs measure real device execution instead of "
+    "async-dispatch lower bounds.  Costs a host sync per dispatch (kills "
+    "async overlap) — leave off outside measurement runs.")
 
 
 def registry() -> List[ConfEntry]:

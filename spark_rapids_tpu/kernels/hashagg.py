@@ -43,6 +43,7 @@ from typing import List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.batch import (
@@ -53,7 +54,7 @@ from spark_rapids_tpu.kernels.layout import compaction_indices
 
 TABLE_SLOTS = 8192          # key-range capacity of the slot table
 _CHUNK = 16384              # rows per exact-f32 accumulation chunk
-_SIGN32 = jnp.uint32(0x80000000)
+_SIGN32 = np.uint32(0x80000000)
 
 
 def _limb_rows_u32(w, use, bits: int) -> List[jnp.ndarray]:

@@ -25,14 +25,16 @@ from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.batch import ColumnBatch, round_up_capacity
+from spark_rapids_tpu.utils.compile_registry import instrumented_jit
 from spark_rapids_tpu.exprs.base import DevVal
 from spark_rapids_tpu.kernels.layout import compaction_indices, gather_rows
 
-_C1 = jnp.uint32(0xCC9E2D51)
-_C2 = jnp.uint32(0x1B873593)
+_C1 = np.uint32(0xCC9E2D51)
+_C2 = np.uint32(0x1B873593)
 
 
 def _mix32(h, w):
@@ -123,7 +125,7 @@ def _phase1(probe_h1, probe_ok, probe_live, build_sorted_h1, build_live_n):
     return lo.astype(jnp.int32), counts, jnp.sum(counts)
 
 
-_phase1_jit = jax.jit(_phase1)
+_phase1_jit = instrumented_jit(_phase1, label="join:phase1")
 
 
 def _build_sort(h1, h2):
@@ -133,7 +135,7 @@ def _build_sort(h1, h2):
     return perm, s1
 
 
-_build_sort_jit = jax.jit(_build_sort)
+_build_sort_jit = instrumented_jit(_build_sort, label="join:build_sort")
 
 
 def join_pairs(left_keys: List[DevVal], left_num_rows,

@@ -37,6 +37,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from spark_rapids_tpu.utils.compile_registry import instrumented_jit
+
 BLOCK = 16384  # bytes of match output per program (128-aligned)
 
 
@@ -51,7 +53,9 @@ def use_pallas_strings() -> bool:
     if flag == "interp":
         return True
     try:
-        return jax.default_backend() not in ("cpu",)
+        # strictly tpu: other accelerator backends (gpu, tunneled plugins)
+        # must NOT take the Pallas TPU lowering
+        return jax.default_backend() == "tpu"
     except Exception:
         return False
 
@@ -75,7 +79,7 @@ def _match_kernel(cur_ref, nxt_ref, scur_ref, snxt_ref, out_ref, *,
     out_ref[...] = m.astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnames=("needle",))
+@instrumented_jit(label="pallas:contains", static_argnames=("needle",))
 def contains_match(data, offsets, needle: tuple):
     """int32[nbytes_padded]: 1 where ``needle`` (tuple of byte values)
     matches starting at this byte position without crossing a row

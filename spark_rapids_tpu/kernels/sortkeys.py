@@ -46,7 +46,10 @@ from spark_rapids_tpu.exprs.base import DevVal
 
 DEFAULT_STRING_PREFIX_BYTES = 64
 
-_SIGN32 = jnp.uint32(1 << 31)
+# numpy (not jnp) scalar: module import can happen lazily inside an active
+# jit trace, where a jnp constant would be created as that trace's tracer
+# and leak into every later program (UnexpectedTracerError)
+_SIGN32 = np.uint32(1 << 31)
 
 # f64 order words are backend-dependent:
 #

@@ -32,6 +32,7 @@ from spark_rapids_tpu.kernels.layout import (
 )
 from spark_rapids_tpu.kernels.sort import sort_batch
 from spark_rapids_tpu.plan.physical import ExecContext, PhysicalOp, TpuExec
+from spark_rapids_tpu.utils.compile_registry import instrumented_jit
 
 
 def shrink_to_fit(batch: ColumnBatch,
@@ -155,7 +156,7 @@ class TpuProjectExec(TpuExec):
             return ColumnBatch(schema, cols, batch.num_rows, batch.capacity)
 
         self.batch_fn = run
-        self._run = jax.jit(run)
+        self._run = instrumented_jit(run, label="TpuProject")
 
     def describe(self):
         return f"TpuProject({', '.join(f.name for f in self.output_schema)})"
@@ -181,7 +182,7 @@ class TpuFilterExec(TpuExec):
             return compact(batch, keep)
 
         self.batch_fn = run
-        self._run = jax.jit(run)
+        self._run = instrumented_jit(run, label="TpuFilter")
 
     def describe(self):
         return f"TpuFilter({self.condition!r})"
@@ -390,7 +391,7 @@ class TpuFusedMapExec(TpuExec):
             return batch
 
         self.batch_fn = composed
-        self._run = jax.jit(composed)
+        self._run = instrumented_jit(composed, label="TpuFusedMap")
 
     def describe(self):
         return f"TpuFusedMap({' -> '.join(self.labels)})"
@@ -459,7 +460,7 @@ class TpuSortExec(TpuExec):
                               [o.ascending for o in self.orders],
                               [o.nulls_first for o in self.orders])
 
-        self._run = jax.jit(run)
+        self._run = instrumented_jit(run, label="TpuSort")
 
     def absorb_input(self, fns):
         # project/filter commute with concat (row-wise / stable), so fused
@@ -538,17 +539,18 @@ class TpuHashAggregateExec(TpuExec):
         from spark_rapids_tpu.kernels.hashagg import TABLE_SLOTS
         self._mxu_table = TABLE_SLOTS  # refreshed from conf in _hash_active
 
-        @jax.jit
+        @instrumented_jit(label="TpuHashAggregate")
         def run(batch: ColumnBatch) -> ColumnBatch:
             return self._aggregate_batch(batch)
 
-        @jax.jit
+        @instrumented_jit(label="TpuHashAggregate:hash")
         def run_hash(batch: ColumnBatch):
             return self._aggregate_batch_hash(batch)
 
         self._run = run
         self._run_hash = run_hash
-        self._merge_run = jax.jit(self._merge_partials)
+        self._merge_run = instrumented_jit(self._merge_partials,
+                                           label="TpuHashAggregate:merge")
         self._input_fns = []
 
     def absorb_input(self, fns):
@@ -568,8 +570,9 @@ class TpuHashAggregateExec(TpuExec):
                 batch = f(batch)
             return self._aggregate_batch_hash(batch)
 
-        self._run = jax.jit(run)
-        self._run_hash = jax.jit(run_hash)
+        self._run = instrumented_jit(run, label="TpuHashAggregate")
+        self._run_hash = instrumented_jit(run_hash,
+                                          label="TpuHashAggregate:hash")
 
     def _hash_active(self, ctx) -> bool:
         from spark_rapids_tpu.config import (
@@ -1193,7 +1196,7 @@ class TpuExpandExec(TpuExec):
         self._runs = []
         for proj in projections:
             def make(proj=proj):
-                @jax.jit
+                @instrumented_jit(label="TpuExpand")
                 def run(batch):
                     ctx = TpuEvalCtx(batch)
                     cols = [e.tpu_eval(ctx).to_column() for e in proj]

@@ -297,7 +297,11 @@ class TpuWindowExec(TpuExec):
                 repr(w.order_by) == repr(self.order_by), \
                 "one Window exec handles one (partition, order) spec"
 
-        @jax.jit
+        from spark_rapids_tpu.utils.compile_registry import (
+            instrumented_jit,
+        )
+
+        @instrumented_jit(label="TpuWindow")
         def run(batch: ColumnBatch) -> ColumnBatch:
             return self._compute(batch)
 

@@ -50,7 +50,10 @@ def make_distributed_agg_step(mesh: Mesh, cap: int):
     n = mesh.shape[DATA_AXIS]
     exchange = make_exchange_fn(mesh, n_cols=2, cap=cap)
 
-    from jax import shard_map
+    try:
+        from jax import shard_map  # jax >= 0.6 top-level export
+    except ImportError:  # jax 0.4.x keeps it in experimental
+        from jax.experimental.shard_map import shard_map
 
     def local_agg(keys, values, validity, num_rows):
         k, v, val, nr = keys[0], values[0], validity[0], num_rows[0]
@@ -162,12 +165,16 @@ def run_distributed_query_demo(n_devices: int, n_rows: int = 4000) -> dict:
     tpu = (TpuSparkSession.builder()
            .config("spark.rapids.shuffle.ici.enabled", True)
            .config("spark.rapids.sql.variableFloatAgg.enabled", True)
+           # accurate-sync metrics: shuffleWallNs must measure the real
+           # all_to_all (the demo REPORTS shuffle_gb_per_sec from it; the
+           # default async lower bound would inflate it arbitrarily)
+           .config("spark.rapids.sql.tpu.metrics.detailEnabled", True)
            .config("spark.sql.shuffle.partitions", n_devices)
            .get_or_create())
     got_rows = build(tpu).collect()
 
     mesh_ops = [op for op, ms in tpu.last_metrics.items()
-                if ms.get("meshExchanges")]
+                if isinstance(ms, dict) and ms.get("meshExchanges")]
     assert mesh_ops, \
         f"no exchange took the mesh path; metrics={tpu.last_metrics}"
 
@@ -183,6 +190,7 @@ def run_distributed_query_demo(n_devices: int, n_rows: int = 4000) -> dict:
     jrows = joined.collect()
     assert len(jrows) == n_rows, (len(jrows), n_rows)
     join_mesh_ops = [op for op, ms in tpu.last_metrics.items()
+                     if isinstance(ms, dict)
                      if ms.get("meshExchanges")]
     assert len(join_mesh_ops) >= 2, tpu.last_metrics  # both join sides
 
@@ -234,6 +242,10 @@ def run_distributed_scale_demo(n_devices: int,
     tpu = (TpuSparkSession.builder()
            .config("spark.rapids.shuffle.ici.enabled", True)
            .config("spark.rapids.sql.variableFloatAgg.enabled", True)
+           # accurate-sync metrics: shuffleWallNs must measure the real
+           # all_to_all (the demo REPORTS shuffle_gb_per_sec from it; the
+           # default async lower bound would inflate it arbitrarily)
+           .config("spark.rapids.sql.tpu.metrics.detailEnabled", True)
            .config("spark.sql.shuffle.partitions", n_devices)
            .get_or_create())
     from spark_rapids_tpu import types as T

@@ -28,6 +28,7 @@ from spark_rapids_tpu.parallel.partitioning import (
 from spark_rapids_tpu.plan.physical import (
     CpuExec, ExecContext, PhysicalOp, TpuExec,
 )
+from spark_rapids_tpu.utils.compile_registry import instrumented_jit
 
 _RANGE_SAMPLE_ROWS = 4096
 
@@ -39,9 +40,8 @@ def _collapse_local_conf(ctx) -> bool:
     Collapsing removes the per-batch count sync + one gather per target
     partition — pure overhead on one device.  The mesh (multi-device)
     path does its own all-to-all instead."""
-    return ctx.conf.get(
-        "spark.rapids.sql.tpu.exchange.collapseLocal", True) \
-        not in (False, "false")
+    from spark_rapids_tpu.config import EXCHANGE_COLLAPSE_LOCAL
+    return EXCHANGE_COLLAPSE_LOCAL.get(ctx.conf)
 
 
 class CpuShuffleExchangeExec(CpuExec):
@@ -114,8 +114,9 @@ class TpuShuffleExchangeExec(TpuExec):
         self.partitioning = partitioning
         self._input_fns = []
         self._fused_map = None
-        self._sort_by_pid = jax.jit(self._sort_by_pid_impl,
-                                    static_argnames=("n",))
+        self._sort_by_pid = instrumented_jit(self._sort_by_pid_impl,
+                                             label="TpuShuffleExchange:split",
+                                             static_argnames=("n",))
 
     def absorb_input(self, fns):
         """Fuse upstream map-like stages into the partition-split program
@@ -204,7 +205,8 @@ class TpuShuffleExchangeExec(TpuExec):
                         b = f(b)
                     return b
 
-                self._fused_map = jax.jit(composed)
+                self._fused_map = instrumented_jit(
+                    composed, label="TpuShuffleExchange:map")
             batches = [self._fused_map(b) for b in batches]
         if not batches:
             return [iter([]) for _ in range(n)]
@@ -227,12 +229,20 @@ class TpuShuffleExchangeExec(TpuExec):
             local_batches.append(merged)
             pids_list.append(jnp.asarray(pid, jnp.int32))
         import time as _time
+
+        from spark_rapids_tpu.utils.tracing import metrics_detail
         stats: dict = {}
         t0 = _time.monotonic_ns()
         out = mesh_exchange_batches(mesh, local_batches, pids_list,
                                     self.output_schema, stats=stats)
-        if out:
+        # No unconditional host sync here: blocking on the all_to_all kills
+        # its async overlap with downstream dispatch (the whole point of
+        # the collective path).  Default shuffleWallNs is therefore a
+        # dispatch-wall LOWER BOUND; the accurate-sync path rides the
+        # metrics-detail conf for measurement runs.
+        if out and metrics_detail(ctx.conf):
             jax.block_until_ready(out)
+            ctx.metric(self.op_id, "shuffleWallSyncs").add(1)
         wall_ns = _time.monotonic_ns() - t0
         ctx.metric(self.op_id, "meshExchanges").add(1)
         ctx.metric(self.op_id, "meshDevices").add(n)
@@ -263,7 +273,8 @@ class TpuShuffleExchangeExec(TpuExec):
                         b = f(b)
                     return b
 
-                self._fused_map = jax.jit(composed)
+                self._fused_map = instrumented_jit(
+                    composed, label="TpuShuffleExchange:map")
 
             def gen():
                 for part in in_parts:

@@ -139,7 +139,10 @@ def make_exchange_fn(mesh: Mesh, n_cols: int, cap: int):
         return ([c[None] for c in o_data], [v[None] for v in o_valid],
                 o_rows[None])
 
-    from jax import shard_map
+    try:
+        from jax import shard_map  # jax >= 0.6 top-level export
+    except ImportError:  # jax 0.4.x keeps it in experimental
+        from jax.experimental.shard_map import shard_map
     in_specs = (
         [P(DATA_AXIS, None)] * n_cols,
         [P(DATA_AXIS, None)] * n_cols,
@@ -353,7 +356,10 @@ def _make_mesh_payload_fn(mesh: Mesh, sig, cap: int, ecaps: tuple,
         outs.append(total[None])
         return outs
 
-    from jax import shard_map
+    try:
+        from jax import shard_map  # jax >= 0.6 top-level export
+    except ImportError:  # jax 0.4.x keeps it in experimental
+        from jax.experimental.shard_map import shard_map
     in_specs = []
     for is_varlen in sig:
         k = 3 if is_varlen else 2

@@ -210,8 +210,8 @@ class TpuOverrides:
             print(self.last_explain)
         phys = self._convert(meta)
         phys = _insert_transitions(phys)
-        if self.conf.get("spark.rapids.sql.fusion.enabled", True) \
-                not in (False, "false"):
+        from spark_rapids_tpu.config import FUSION_ENABLED
+        if FUSION_ENABLED.get(self.conf):
             phys = _fuse_map_chains(phys)
         return phys
 

@@ -157,13 +157,11 @@ class HostToDeviceExec(TpuExec):
                 yield stage(hb, catalog)
 
         def stage_nosem(hb, catalog):
-            # worker-thread variant: NO semaphore.  TpuSemaphore is
-            # re-entrant per THREAD with the held-depth in a
-            # thread-local, and the paired release happens on the main
-            # thread (DeviceToHostExec) — a worker-side acquire would
-            # leak its permit forever and deadlock the next partition's
-            # worker.  Admission is instead taken by the CONSUMER below
-            # before the batch is yielded downstream; the read-ahead
+            # worker-thread variant: NO semaphore acquire here.  Admission
+            # is taken by the CONSUMER below before the batch is yielded
+            # downstream, pairing with the release when results leave the
+            # device (TpuSemaphore depth is task-wide, so the thread the
+            # acquire/release lands on no longer matters); the read-ahead
             # transfer itself rides the catalog's OOM-retry.
             from spark_rapids_tpu.mem.catalog import run_with_oom_retry
             t0 = time.monotonic()
@@ -188,23 +186,28 @@ class HostToDeviceExec(TpuExec):
             stop = threading.Event()
             DONE = object()
 
+            def put_bounded(item):
+                # never a blocking put: a consumer that already left its
+                # finally-drain must not strand the worker (it would hold
+                # generator/device state past the query)
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.25)
+                        return True
+                    except queue.Full:
+                        continue
+                return False
+
             def worker():
                 try:
                     for hb in part:
                         if stop.is_set():
                             return
-                        item = ("b", stage_nosem(hb, catalog))
-                        while not stop.is_set():
-                            try:
-                                q.put(item, timeout=0.25)
-                                break
-                            except queue.Full:
-                                continue
-                        else:
+                        if not put_bounded(("b", stage_nosem(hb, catalog))):
                             return
-                    q.put(DONE)
+                    put_bounded(DONE)
                 except BaseException as e:  # surfaced on the consumer side
-                    q.put(("e", e))
+                    put_bounded(("e", e))
 
             t = threading.Thread(target=worker, daemon=True,
                                  name="stage-readahead")
@@ -230,6 +233,13 @@ class HostToDeviceExec(TpuExec):
                         q.get_nowait()
                 except queue.Empty:
                     pass
+                # Reap the worker (bounded): a worker wedged inside a
+                # device transfer would otherwise outlive the query and
+                # leak its generator state into the next test/query —
+                # the cross-suite-state-leak shape.  The drain above
+                # unblocks any q.put wait, so a healthy worker exits
+                # within the put timeout.
+                t.join(timeout=5.0)
 
         mk = gen_pipelined if depth > 0 else gen
         return [mk(p) for p in child_parts]
@@ -284,10 +294,13 @@ def run_partition_with_retry(root: PhysicalOp, ctx: ExecContext,
 
 def collect_host(op: PhysicalOp, ctx: ExecContext) -> HostBatch:
     """Drive a plan to completion and concatenate all partitions on host."""
+    from spark_rapids_tpu.utils.tracing import trace_range
     try:
         if op.is_tpu:
             from spark_rapids_tpu.plan.pipeline import pipeline_collect
-            hb = pipeline_collect(op, ctx)
+            with trace_range("pipeline_collect",
+                             ctx.metric("collect", "wallTimeNs")):
+                hb = pipeline_collect(op, ctx)
             if hb is not None:
                 return hb
         root = op if not op.is_tpu else DeviceToHostExec(op)
@@ -296,7 +309,8 @@ def collect_host(op: PhysicalOp, ctx: ExecContext) -> HostBatch:
         parts = root.partitions(ctx)
         for i, part in enumerate(parts):
             try:
-                got = list(part)
+                with trace_range(f"partition:{i}"):
+                    got = list(part)
             except MemoryError:
                 raise
             except Exception:

@@ -13,18 +13,25 @@ fusion.
 
 Stage boundaries ("stage breaks") sit where live rows collapse far below
 capacity (aggregate partials): the driver syncs the live sizes once (one
-round trip), re-buckets with a compiled gather, and feeds the shrunk
-batches to the next stage — otherwise padded capacities would snowball
-through concats and every downstream sort would pay O(padded).
+round trip), re-buckets the shrunk batches and feeds them to the next stage
+— otherwise padded capacities would snowball through concats and every
+downstream sort would pay O(padded).  With
+``spark.rapids.sql.tpu.pipeline.fuseTail.enabled`` (default) the
+re-bucketing gather is not a separate dispatched program: it compiles INTO
+the consuming tail stage (cached per shrunk-bucket signature), so the
+final merge-aggregate/order-by/limit tail costs one dispatch, not two.
 
 Ops that cannot be inlined (host transitions, joins needing host-visible
 output sizing, samples with host RNG) become pipeline *sources*: their
 iterator path materializes batches that feed the program as arguments.
+
+Every stage program dispatch is counted and device-timed
+(utils/compile_registry + utils/tracing), feeding the per-query
+``dispatchCount`` / ``compileCount`` / ``deviceTimeNs`` metrics.
 """
 
 from __future__ import annotations
 
-import functools
 from typing import Callable, List, Optional, Tuple
 
 import jax
@@ -32,10 +39,12 @@ import jax.numpy as jnp
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.batch import (
-    ColumnBatch, HostBatch, device_to_host_many, host_sizes,
+    BUCKETS, ColumnBatch, HostBatch, device_to_host_many, host_sizes,
     round_up_capacity,
 )
 from spark_rapids_tpu.plan.physical import ExecContext, PhysicalOp, TpuExec
+from spark_rapids_tpu.utils.compile_registry import instrumented_jit
+from spark_rapids_tpu.utils.tracing import device_dispatch
 
 
 def concat_static(batches: List[ColumnBatch], schema: T.Schema
@@ -49,9 +58,8 @@ def concat_static(batches: List[ColumnBatch], schema: T.Schema
     byte_caps = []
     for i, f in enumerate(schema.fields):
         if f.dtype.is_string or f.dtype.is_array:
-            byte_caps.append(round_up_capacity(
-                sum(int(b.columns[i].data.shape[0]) for b in batches),
-                minimum=16))
+            byte_caps.append(BUCKETS.elems(
+                sum(int(b.columns[i].data.shape[0]) for b in batches)))
     acc = batches[0]
     for nxt in batches[1:]:
         acc = concat_pair(acc, nxt, cap, out_byte_caps=byte_caps or None)
@@ -84,8 +92,15 @@ def build_pipeline(op: PhysicalOp, ctx: ExecContext,
     return f
 
 
-# Padded outputs smaller than this skip the sizes round-trip + shrink.
-_SHRINK_BYTES = 4 << 20
+def _shrink_threshold(ctx: ExecContext) -> int:
+    """Padded outputs at or below this skip the sizes round-trip + shrink."""
+    from spark_rapids_tpu.config import PIPELINE_SHRINK_BYTES
+    return PIPELINE_SHRINK_BYTES.get(ctx.conf)
+
+
+def _fuse_tail_enabled(ctx: ExecContext) -> bool:
+    from spark_rapids_tpu.config import PIPELINE_FUSE_TAIL
+    return PIPELINE_FUSE_TAIL.get(ctx.conf)
 
 
 def _batch_padded_bytes(b: ColumnBatch) -> int:
@@ -98,57 +113,117 @@ def _batch_padded_bytes(b: ColumnBatch) -> int:
     return total
 
 
-@functools.partial(jax.jit, static_argnames=("caps", "bcapss"))
+def _shrink_gather(b: ColumnBatch, cap: int, bcaps: Tuple[int, ...]
+                   ) -> ColumnBatch:
+    """One compiled gather re-bucketing ``b`` to (cap, bcaps) — traceable,
+    used both by the standalone shrink program and inlined in fused tail
+    stage prologues."""
+    from spark_rapids_tpu.kernels.layout import gather_rows
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    return gather_rows(b, idx, b.num_rows, out_capacity=cap,
+                       out_byte_caps=list(bcaps) or None)
+
+
+@instrumented_jit(label="pipeline:shrink", static_argnames=("caps", "bcapss"))
 def _shrink_jit(bs: Tuple[ColumnBatch, ...], caps: Tuple[int, ...],
                 bcapss: Tuple[Tuple[int, ...], ...]):
-    from spark_rapids_tpu.kernels.layout import gather_rows
-    out = []
-    for b, cap, bcaps in zip(bs, caps, bcapss):
-        idx = jnp.arange(cap, dtype=jnp.int32)
-        out.append(gather_rows(b, idx, b.num_rows, out_capacity=cap,
-                               out_byte_caps=list(bcaps) or None))
-    return tuple(out)
+    return tuple(_shrink_gather(b, cap, bcaps)
+                 for b, cap, bcaps in zip(bs, caps, bcapss))
+
+
+def _shrink_spec(outs: List[ColumnBatch], ctx: ExecContext):
+    """Per-batch (row cap, varlen byte caps) re-bucketing spec for a stage
+    break's raw outputs — ONE sizes round trip for all batches — or None
+    when the padded total is too small to be worth a shrink."""
+    if not outs or sum(_batch_padded_bytes(b) for b in outs) <= \
+            _shrink_threshold(ctx):
+        return None
+    sizes = host_sizes(outs)
+    return tuple(
+        (BUCKETS.rows(n), tuple(BUCKETS.elems(t) for t in totals))
+        for n, totals in sizes)
 
 
 def _shrink_outputs(outs: List[ColumnBatch], ctx: ExecContext
                     ) -> List[ColumnBatch]:
     """Sizes round trip + one compiled gather re-bucketing every batch."""
-    if not outs or sum(_batch_padded_bytes(b) for b in outs) <= _SHRINK_BYTES:
+    spec = _shrink_spec(outs, ctx)
+    if spec is None:
         return outs
-    sizes = host_sizes(outs)
     ctx.metric("pipeline", "shrinks").add(1)
-    caps = tuple(round_up_capacity(max(n, 1)) for n, _ in sizes)
-    bcapss = tuple(
-        tuple(round_up_capacity(max(t, 16), minimum=16) for t in totals)
-        for _, totals in sizes)
+    caps = tuple(c for c, _ in spec)
+    bcapss = tuple(bc for _, bc in spec)
     return list(_shrink_jit(tuple(outs), caps, bcapss))
 
 
-def _materialize_source(src: PhysicalOp, ctx: ExecContext
-                        ) -> List[ColumnBatch]:
+def _materialize_source(src: PhysicalOp, ctx: ExecContext, fuse: bool
+                        ) -> Tuple[List[ColumnBatch], Optional[tuple]]:
+    """Materialize one stage source -> (batches, shrink_spec).
+
+    Stage-break sources with tail fusion on return their RAW (unshrunk)
+    outputs plus the re-bucketing spec the consumer compiles into its own
+    program; everything else returns spec=None.
+    """
     from spark_rapids_tpu.plan.physical import HostToDeviceExec
     if getattr(src, "pipeline_stage_break", False):
-        return _run_stage(src, ctx)
+        if not fuse:
+            return _run_stage(src, ctx), None
+        outs = _run_stage(src, ctx, shrink=False)
+        spec = _shrink_spec(outs, ctx)
+        if spec is not None:
+            ctx.metric("pipeline", "fusedShrinks").add(1)
+        return outs, spec
     batches = []
     for part in src.partitions(ctx):
         batches.extend(part)
     if isinstance(src, HostToDeviceExec):
         ctx._pipeline_h2d = getattr(ctx, "_pipeline_h2d", 0) + len(batches)
-    return batches
+    return batches, None
 
 
-def _stage_program(root: PhysicalOp, ctx: ExecContext, variant: str):
-    """(sources, jitted) for one variant of ``root``'s stage (ops like the
-    hash aggregate compile a fast path and an exact-fallback path)."""
+def _stage_build(root: PhysicalOp, ctx: ExecContext, variant: str):
+    """(sources, composed fn) for one variant of ``root``'s stage (ops like
+    the hash aggregate compose a fast path and an exact-fallback path)."""
+    cache = getattr(root, "_stage_builds", None)
+    if not isinstance(cache, dict):
+        cache = {}
+        root._stage_builds = cache
+    if variant not in cache:
+        sources: List[PhysicalOp] = []
+        fn = build_pipeline(root, ctx, sources, {}, root)
+        cache[variant] = (sources, fn)
+    return cache[variant]
+
+
+def _stage_program(root: PhysicalOp, ctx: ExecContext, variant: str,
+                   spec: Optional[tuple]):
+    """(sources, jitted) for (variant, tail-fusion shrink spec).
+
+    ``spec`` (one entry per source; None = feed raw) bakes the stage-break
+    re-bucketing gathers into the stage program's prologue, so shrink +
+    tail ride ONE dispatch.  Power-of-two bucketing keeps the number of
+    distinct specs — and therefore compiled tail variants — small.
+    """
     cache = getattr(root, "_stage_cache", None)
     if not isinstance(cache, dict):
         cache = {}
         root._stage_cache = cache
-    if variant not in cache:
-        sources: List[PhysicalOp] = []
-        fn = build_pipeline(root, ctx, sources, {}, root)
-        cache[variant] = (sources, jax.jit(lambda args: tuple(fn(args))))
-    return cache[variant]
+    key = (variant, spec)
+    if key not in cache:
+        sources, fn = _stage_build(root, ctx, variant)
+        if spec is None or all(s is None for s in spec):
+            run = lambda args: tuple(fn(args))  # noqa: E731
+        else:
+            def run(args, _spec=spec):
+                shrunk = tuple(
+                    tuple(bs) if sp is None else tuple(
+                        _shrink_gather(b, cap, bcaps)
+                        for b, (cap, bcaps) in zip(bs, sp))
+                    for bs, sp in zip(args, _spec))
+                return tuple(fn(shrunk))
+        cache[key] = (sources,
+                      instrumented_jit(run, label=f"stage:{root.name}"))
+    return cache[key]
 
 
 def _run_oom_guarded(ctx: ExecContext, thunk, args=()):
@@ -164,28 +239,42 @@ def _run_oom_guarded(ctx: ExecContext, thunk, args=()):
         on_retry=lambda _freed: ctx.metric("pipeline", "oom_retries").add(1))
 
 
-def _run_stage(root: PhysicalOp, ctx: ExecContext) -> List[ColumnBatch]:
-    """Execute ``root``'s stage as one program; shrunk device outputs."""
+def _run_stage(root: PhysicalOp, ctx: ExecContext,
+               shrink: bool = True) -> List[ColumnBatch]:
+    """Execute ``root``'s stage as one program.  ``shrink=True`` (the
+    default, for directly-collected stages) re-buckets the outputs;
+    ``shrink=False`` hands raw outputs to a tail-fusing consumer."""
     variant_fn = getattr(root, "stage_variant", None)
     variant = variant_fn(ctx) if variant_fn is not None else "default"
-    sources, jitted = _stage_program(root, ctx, variant)
-    args = tuple(tuple(_materialize_source(s, ctx)) for s in sources)
+    fuse = _fuse_tail_enabled(ctx)
+    sources, _fn = _stage_build(root, ctx, variant)
+    mats = [_materialize_source(s, ctx, fuse) for s in sources]
+    args = tuple(tuple(bs) for bs, _ in mats)
+    spec = tuple(sp for _, sp in mats) if fuse else None
     from spark_rapids_tpu.batch import colocate_batches
     args = tuple(tuple(bs) for bs in colocate_batches(args))
-    ctx.metric("pipeline", "programs").add(1)
-    outs = _run_oom_guarded(ctx, lambda: _shrink_outputs(list(jitted(args)),
-                                                         ctx), args)
+
+    def dispatch(v: str) -> List[ColumnBatch]:
+        s2, jitted = _stage_program(root, ctx, v, spec)
+        assert len(s2) == len(sources), "stage variants disagree"
+        ctx.metric("pipeline", "programs").add(1)
+        with device_dispatch(ctx, "pipeline", root.name) as holder:
+            outs = _run_oom_guarded(
+                ctx,
+                lambda: _shrink_outputs(list(jitted(args)), ctx)
+                if shrink else list(jitted(args)),
+                args)
+            holder["outputs"] = outs
+        return outs
+
+    outs = dispatch(variant)
     post = getattr(root, "postprocess_stage_outputs", None)
     if post is not None:
         def rerun():
             # the op flipped its variant (e.g. hash -> exact sort);
             # re-execute on the SAME materialized source batches
             v2 = variant_fn(ctx) if variant_fn is not None else "default"
-            s2, j2 = _stage_program(root, ctx, v2)
-            assert len(s2) == len(sources), "stage variants disagree"
-            ctx.metric("pipeline", "programs").add(1)
-            return _run_oom_guarded(ctx, lambda: _shrink_outputs(
-                list(j2(args)), ctx), args)
+            return dispatch(v2)
 
         outs = post(ctx, outs, rerun)
     return outs
@@ -195,10 +284,10 @@ def pipeline_collect(root: PhysicalOp, ctx: ExecContext
                      ) -> Optional[HostBatch]:
     """Try to run ``root`` as a whole-pipeline program; None if the plan
     doesn't inline anything (caller falls back to the iterator path)."""
+    from spark_rapids_tpu.config import PIPELINE_ENABLED
     if not root.is_tpu:
         return None
-    if ctx.conf.get("spark.rapids.sql.tpu.pipeline.enabled", True) \
-            in (False, "false"):
+    if not PIPELINE_ENABLED.get(ctx.conf):
         return None
 
     probe = getattr(root, "_pipeline_viable", None)

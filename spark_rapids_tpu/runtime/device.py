@@ -22,39 +22,59 @@ from spark_rapids_tpu.config import RapidsConf
 class TpuSemaphore:
     """Counting semaphore bounding concurrent device-resident tasks.
 
-    Unlike a plain semaphore it is re-entrant per thread (a task thread that
-    already holds it may re-acquire freely), matching
+    Unlike a plain semaphore it is re-entrant per TASK, matching
     GpuSemaphore.acquireIfNecessary semantics (GpuSemaphore.scala:74-87).
+    In this single-process engine a query IS the task, and a query's device
+    work spans threads: the main thread consumes while stage read-ahead
+    workers (plan/physical.py gen_pipelined) drive nested plan sections.
+    The hold depth is therefore shared across threads — a worker whose
+    nested TPU section acquires while the main thread already holds the
+    permit re-enters instead of deadlocking against its own consumer
+    (thread-local depth wedged exactly that way: the worker blocked on the
+    permit the main thread held while the main thread blocked on the
+    worker's queue).  Releases pair by count, on any thread.
     """
 
     def __init__(self, permits: int):
         self._permits = max(1, permits)
-        self._sem = threading.Semaphore(self._permits)
-        self._held = threading.local()
+        self._cond = threading.Condition()
+        self._available = self._permits
+        self._depth = 0
 
     def acquire(self):
-        depth = getattr(self._held, "depth", 0)
-        if depth == 0:
-            self._sem.acquire()
-        self._held.depth = depth + 1
+        with self._cond:
+            while True:
+                if self._depth > 0:
+                    # the task already holds a permit (possibly taken by a
+                    # sibling thread while this one waited): re-enter
+                    self._depth += 1
+                    return
+                if self._available > 0:
+                    self._available -= 1
+                    self._depth = 1
+                    return
+                self._cond.wait()
 
     def release(self):
-        depth = getattr(self._held, "depth", 0)
-        if depth <= 0:
-            return
-        self._held.depth = depth - 1
-        if self._held.depth == 0:
-            self._sem.release()
+        with self._cond:
+            if self._depth <= 0:
+                return
+            self._depth -= 1
+            if self._depth == 0:
+                self._available += 1
+                self._cond.notify()
 
     def release_all(self):
-        depth = getattr(self._held, "depth", 0)
-        if depth > 0:
-            self._held.depth = 0
-            self._sem.release()
+        with self._cond:
+            if self._depth > 0:
+                self._depth = 0
+                self._available += 1
+                self._cond.notify()
 
     def held_depth(self) -> int:
-        """This thread's re-entrant hold depth (0 = no permit held)."""
-        return getattr(self._held, "depth", 0)
+        """The task's re-entrant hold depth (0 = no permit held)."""
+        with self._cond:
+            return self._depth
 
 
 class DeviceRuntime:

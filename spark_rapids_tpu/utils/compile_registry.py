@@ -1,0 +1,178 @@
+"""Compile/dispatch economics: the registry behind every ``jax.jit``
+entry point the execs use.
+
+The reference engine pays no per-query compile tax — cudf kernels ship
+precompiled — so its metrics layer never needed to account for it.  On
+TPU every new (program, shape-bucket) pair costs an XLA compile that can
+dwarf the query itself, and every dispatched program costs a host->device
+round trip.  This module makes both quantities *measured*:
+
+* :func:`instrumented_jit` wraps ``jax.jit`` so each call is counted as a
+  dispatch, and a growth of the jitted function's executable cache is
+  counted as a compile (with the call's wall time attributed to
+  ``compile_wall_ns`` — compile-inclusive first-call wall, the number a
+  user actually waits for).
+* The process-wide tallies are snapshotted around each query by
+  ``session.execute`` into ``last_metrics`` (``compileCount``,
+  ``compileWallNs``, ``dispatchCount``, ``compiledShapes``) and surfaced
+  by ``bench.py`` as ``compile_s``.
+* :func:`enable_persistent_cache` turns on JAX's persistent compilation
+  cache (conf ``spark.rapids.sql.tpu.compileCacheDir``) so repeated
+  processes skip recompilation entirely.
+
+When available, ``jax.monitoring`` backend-compile duration events are
+also accumulated (``backend_compile_ns``) — pure XLA compile seconds,
+excluding the first-run execution that the wall number includes.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+_LOCK = threading.Lock()
+_STATS: Dict[str, int] = {
+    # cumulative process-wide; per-query deltas come from snapshot() pairs
+    "compiles": 0,          # executable-cache misses observed at call sites
+    "compile_wall_ns": 0,   # wall ns of calls that triggered a compile
+    "dispatches": 0,        # jitted program invocations
+    "backend_compile_ns": 0,  # jax.monitoring backend compile durations
+}
+_LABEL_COMPILES: Dict[str, int] = {}
+
+
+def snapshot() -> Dict[str, int]:
+    """Copy of the cumulative counters (take two and subtract for a
+    per-query delta)."""
+    with _LOCK:
+        return dict(_STATS)
+
+
+def delta(before: Dict[str, int], after: Dict[str, int]) -> Dict[str, int]:
+    return {k: after[k] - before.get(k, 0) for k in after}
+
+
+def compiled_shapes() -> int:
+    """Cumulative executables compiled at registered call sites — an UPPER
+    BOUND on distinct (program, shape-bucket) cardinality.  Exact within a
+    session (plan/exec memoization means a shape compiles once); across
+    sessions the same shape recompiles and is counted again, so suite-level
+    trends, not absolute cardinality, are what this metric shows."""
+    with _LOCK:
+        return _STATS["compiles"]
+
+
+def per_label_compiles() -> Dict[str, int]:
+    with _LOCK:
+        return dict(_LABEL_COMPILES)
+
+
+def _record(label: str, compiled: bool, wall_ns: int) -> None:
+    with _LOCK:
+        _STATS["dispatches"] += 1
+        if compiled:
+            _STATS["compiles"] += 1
+            _STATS["compile_wall_ns"] += wall_ns
+            _LABEL_COMPILES[label] = _LABEL_COMPILES.get(label, 0) + 1
+
+
+def _cache_size(jitted) -> int:
+    try:
+        return jitted._cache_size()
+    except Exception:  # noqa: BLE001 — older/newer jax without the probe
+        return -1
+
+
+def _trace_state_clean() -> bool:
+    """False while jax is tracing (a nested-jit call inlines, it doesn't
+    dispatch)."""
+    try:
+        return jax.core.trace_state_clean()
+    except Exception:  # noqa: BLE001
+        return True
+
+
+def instrumented_jit(fn: Optional[Callable] = None, *, label: str = "",
+                     **jit_kwargs) -> Callable:
+    """``jax.jit`` with dispatch/compile accounting.
+
+    Usable as ``instrumented_jit(f, label=...)`` or as a decorator
+    ``@instrumented_jit(label=..., static_argnames=...)``.  The wrapper is
+    call-compatible with the jitted function; the raw jitted callable is
+    exposed as ``wrapper.jitted``.
+    """
+    if fn is None:
+        return functools.partial(instrumented_jit, label=label, **jit_kwargs)
+    name = label or getattr(fn, "__name__", "jit")
+    jitted = jax.jit(fn, **jit_kwargs)
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if not _trace_state_clean():
+            # nested call while an outer program is being traced: it
+            # inlines into the outer jaxpr, so it is neither a device
+            # dispatch nor a separate compile — don't count it
+            return jitted(*args, **kwargs)
+        before = _cache_size(jitted)
+        t0 = time.monotonic_ns()
+        out = jitted(*args, **kwargs)
+        after = _cache_size(jitted)
+        compiled = after >= 0 and after != before
+        _record(name, compiled, time.monotonic_ns() - t0)
+        return out
+
+    wrapper.jitted = jitted
+    wrapper.label = name
+    return wrapper
+
+
+# -- jax.monitoring hook (precise backend compile seconds) -------------------
+
+_MONITORING_HOOKED = False
+
+
+def _on_event_duration(event: str, duration_secs: float, **kw) -> None:
+    if "compil" not in event:
+        return
+    with _LOCK:
+        _STATS["backend_compile_ns"] += int(duration_secs * 1e9)
+
+
+def _hook_monitoring() -> None:
+    global _MONITORING_HOOKED
+    if _MONITORING_HOOKED:
+        return
+    try:
+        from jax import monitoring
+        monitoring.register_event_duration_secs_listener(_on_event_duration)
+        _MONITORING_HOOKED = True
+    except Exception:  # noqa: BLE001 — monitoring API is best-effort
+        _MONITORING_HOOKED = True  # don't retry every call
+
+
+_hook_monitoring()
+
+
+# -- persistent compilation cache --------------------------------------------
+
+_PERSISTENT_DIR: Optional[str] = None
+
+
+def enable_persistent_cache(cache_dir: str,
+                            min_compile_secs: float = 1.0) -> None:
+    """Point XLA's persistent compilation cache at ``cache_dir`` (conf
+    ``spark.rapids.sql.tpu.compileCacheDir``): executables survive the
+    process, so a re-run pre-warms from disk instead of recompiling."""
+    global _PERSISTENT_DIR
+    if not cache_dir or _PERSISTENT_DIR == cache_dir:
+        return
+    import os
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      min_compile_secs)
+    _PERSISTENT_DIR = cache_dir

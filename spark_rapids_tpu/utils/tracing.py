@@ -5,6 +5,14 @@ Reference analogue: NvtxWithMetrics (NvtxWithMetrics.scala:27-36) — one
 profiler side is XProf via ``jax.profiler.TraceAnnotation`` (the XLA runtime
 exports these through the PJRT profiler C API, SURVEY.md section 2.9 NVTX
 row); the metric side is the ExecContext Metric objects.
+
+Device-time accounting: jax dispatch is asynchronous, so the wall time of a
+dispatch call is only a *lower bound* on device execution.  The accurate
+number needs a ``block_until_ready`` on the outputs — a host sync that
+costs a tunnel round trip and kills async overlap, so it is gated behind
+``spark.rapids.sql.tpu.metrics.detailEnabled`` (off by default).
+:func:`device_dispatch` implements both modes for the dispatch sites in
+``plan/pipeline.py`` / ``plan/physical.py``.
 """
 
 from __future__ import annotations
@@ -15,6 +23,14 @@ from typing import Optional
 
 import jax.profiler
 
+from spark_rapids_tpu.config import METRICS_DETAIL
+
+
+def metrics_detail(conf) -> bool:
+    """True when the accurate-sync metrics path is enabled (the cheap
+    lower-bound path is the default)."""
+    return METRICS_DETAIL.get(conf)
+
 
 @contextlib.contextmanager
 def trace_range(name: str, metric=None):
@@ -24,6 +40,28 @@ def trace_range(name: str, metric=None):
         yield
     if metric is not None:
         metric.add(time.monotonic_ns() - t0)
+
+
+@contextlib.contextmanager
+def device_dispatch(ctx, op_id: str, name: str):
+    """Time one device program dispatch into ``ctx.metric(op_id,
+    'deviceTimeNs')`` under a profiler range.
+
+    The body sets ``holder['outputs']`` to the dispatched result.  With
+    the metrics-detail conf on, the outputs are blocked on before the
+    clock stops — on pre-staged (already device-resident) inputs that
+    delta IS device execution time; ``deviceTimeSyncs`` counts how many
+    accurate samples the total contains.  Detail off: the dispatch wall
+    alone is recorded (a lower bound, async dispatch).
+    """
+    holder: dict = {}
+    t0 = time.monotonic_ns()
+    with jax.profiler.TraceAnnotation(f"{op_id}:{name}"):
+        yield holder
+        if metrics_detail(ctx.conf) and holder.get("outputs") is not None:
+            jax.block_until_ready(holder["outputs"])
+            ctx.metric(op_id, "deviceTimeSyncs").add(1)
+    ctx.metric(op_id, "deviceTimeNs").add(time.monotonic_ns() - t0)
 
 
 def start_profile(logdir: str):

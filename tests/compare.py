@@ -35,17 +35,25 @@ def _canon(rows, approx, ignore_order):
         if isinstance(v, float):
             if v != v:
                 return (1, "NaN")
-            if approx:
-                # platform=tpu: f64 emulation -> fewer trustworthy digits
-                return (1, round(v, 3 if TEST_PLATFORM == "tpu" else 6))
+            # No absolute-decimal rounding: _row_approx_eq compares with
+            # RELATIVE tolerance, so large magnitudes (where 3 decimals is
+            # far tighter than f64-emulation error) and tiny ones (where
+            # it is uselessly loose) are both judged proportionally.
             return (1, v)
         if isinstance(v, bool):
             return (2, v)
         return (3, str(v)) if not isinstance(v, (int, float)) else (1, v)
 
+    def sort_key(r):
+        # floats keyed by a relative (significant-digit) canonicalization
+        # so near-equal CPU/TPU values land in the same sort position
+        return str(tuple(
+            (t, float(f"{v:.6g}")) if isinstance(v, float) else (t, v)
+            for t, v in r))
+
     out = [tuple(enc(v) for v in r) for r in rows]
     if ignore_order:
-        out = sorted(out, key=lambda r: str(r))
+        out = sorted(out, key=sort_key)
     return out
 
 
@@ -91,7 +99,10 @@ def _row_approx_eq(ra, rb, i):
     for (ta, va), (tb, vb) in zip(ra, rb):
         assert ta == tb, f"row {i}: {va!r} vs {vb!r}"
         if isinstance(va, float) and isinstance(vb, float):
+            # rel dominates for large magnitudes; the abs floor covers
+            # near-zero values (where the old 6-decimal rounding was
+            # effectively a ~5e-7 absolute tolerance)
             assert vb == pytest.approx(va, rel=max(FLOAT_REL, 1e-5),
-                                       abs=max(FLOAT_ABS, 1e-8)), f"row {i}"
+                                       abs=max(FLOAT_ABS, 1e-6)), f"row {i}"
         else:
             assert va == vb, f"row {i}: {va!r} vs {vb!r}"

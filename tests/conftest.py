@@ -33,12 +33,63 @@ if TEST_PLATFORM != "tpu":
     # for tests.
     jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache across suite runs: the suite is
+# compile-bound (every test's fresh execs re-jit), and cached executables
+# cut repeat-run wall time substantially.  Content-addressed, safe to
+# share; delete the directory to force cold compiles.
+_XLA_CACHE = os.environ.get("SPARK_RAPIDS_TEST_XLA_CACHE",
+                            "/tmp/rapids_tpu_test_xla_cache")
+
 import spark_rapids_tpu  # noqa: F401  (enables x64)
+
+if _XLA_CACHE:
+    from spark_rapids_tpu.utils.compile_registry import (
+        enable_persistent_cache,
+    )
+    enable_persistent_cache(_XLA_CACHE, min_compile_secs=0.5)
 
 # f64 emulation on TPU carries ~48 mantissa bits; aggregations also reorder
 # float reductions.  CPU mode keeps tight tolerances.
 FLOAT_REL = 1e-4 if TEST_PLATFORM == "tpu" else 1e-6
 FLOAT_ABS = 1e-6 if TEST_PLATFORM == "tpu" else 1e-9
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running integration tests excluded from the quick "
+        "(-m 'not slow') tier-1 pass; still run by a direct invocation")
+
+
+# Per-test wall-clock bound (ci/run_ci.sh exports PYTEST_PER_TEST_TIMEOUT):
+# a wedged test — historically a cross-suite state leak around test #262 —
+# fails loudly with a TimeoutError instead of hanging the whole run.
+# SIGALRM-based (tests execute on the main thread); 0/unset disables.
+_PER_TEST_TIMEOUT = float(os.environ.get("PYTEST_PER_TEST_TIMEOUT", "0") or 0)
+
+if _PER_TEST_TIMEOUT > 0:
+    import signal
+
+    @pytest.hookimpl(hookwrapper=True)
+    def pytest_runtest_call(item):
+        def on_timeout(signum, frame):
+            import faulthandler
+            import sys
+            # all-thread stacks: the wedged thread is usually NOT the main
+            # thread (e.g. a stage worker stuck in a device transfer)
+            faulthandler.dump_traceback(file=sys.stderr)
+            raise TimeoutError(
+                f"test exceeded PYTEST_PER_TEST_TIMEOUT="
+                f"{_PER_TEST_TIMEOUT:g}s (wedged? check for leaked "
+                f"worker threads / device state from earlier tests)")
+
+        old = signal.signal(signal.SIGALRM, on_timeout)
+        signal.setitimer(signal.ITIMER_REAL, _PER_TEST_TIMEOUT)
+        try:
+            yield
+        finally:
+            signal.setitimer(signal.ITIMER_REAL, 0)
+            signal.signal(signal.SIGALRM, old)
 
 
 @pytest.fixture

@@ -73,7 +73,7 @@ def _people_df(sess, n=500, parts=5):
 
 def _assert_mesh_used(sess):
     ops = [op for op, ms in sess.last_metrics.items()
-           if ms.get("meshExchanges")]
+           if isinstance(ms, dict) and ms.get("meshExchanges")]
     assert ops, f"no mesh exchange ran: {sess.last_metrics}"
 
 

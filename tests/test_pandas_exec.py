@@ -171,8 +171,11 @@ def test_worker_slot_does_not_leak_device_permits():
     with python_worker_slot(ctx):
         pass
     assert sem.held_depth() == 0
-    assert sem._sem.acquire(blocking=False)  # permit still available
-    sem._sem.release()
+    # permit still available: a fresh acquire must succeed immediately
+    sem.acquire()
+    assert sem.held_depth() == 1
+    sem.release()
+    assert sem.held_depth() == 0
     # and a holder releases + re-acquires cleanly
     sem.acquire()
     with python_worker_slot(ctx):
