@@ -180,6 +180,17 @@ def time_engine(tpu_enabled: bool, data, runs: int = 3,
         "dispatch_count": repeat.get("dispatchCount", 0),
         "compiled_shapes": repeat.get("compiledShapes", 0),
         "device_ms": round(device.get("deviceTimeNs", 0) / 1e6, 3),
+        # data-plane economics: donation is steady-state (every repeat run
+        # reuses consumed-input HBM); H2D staging happens at warmup (the
+        # cached input stages once), D2H on every collect.  bytes/ns IS
+        # GB/s.
+        "donated_bytes": repeat.get("donatedBytes", 0),
+        "h2d_gb_per_sec": round(
+            warm.get("h2dBytes", 0) / warm["h2dTimeNs"], 3)
+        if warm.get("h2dTimeNs") else 0.0,
+        "d2h_gb_per_sec": round(
+            repeat.get("d2hBytes", 0) / repeat["d2hTimeNs"], 3)
+        if repeat.get("d2hTimeNs") else 0.0,
     }
     return best, econ
 
@@ -288,6 +299,11 @@ def _bytes_per_row(data) -> int:
     return sum(int(np.asarray(v).dtype.itemsize) for _, v in data.values())
 
 
+def _async_partitions_default() -> bool:
+    from spark_rapids_tpu.config import PIPELINE_ASYNC_PARTITIONS, RapidsConf
+    return bool(PIPELINE_ASYNC_PARTITIONS.get(RapidsConf()))
+
+
 def main():
     try:
         platform = wait_for_backend()
@@ -352,6 +368,13 @@ def main():
         "device_ms": tpu_econ["device_ms"],
         "device_gb_per_sec": round(data_bytes / device_s / 1e9, 3)
         if device_s > 0 else 0.0,
+        # data-plane economics: steady-state donated input bytes, the
+        # host->device staging rate (warmup: the cached input stages once)
+        # and the device->host result-copy rate (every collect)
+        "donated_bytes": tpu_econ["donated_bytes"],
+        "h2d_gb_per_sec": tpu_econ["h2d_gb_per_sec"],
+        "d2h_gb_per_sec": tpu_econ["d2h_gb_per_sec"],
+        "async_partitions": _async_partitions_default(),
         "platform": platform,
         "scan_rows_per_sec": round(SCAN_ROWS / scan_tpu, 1),
         "scan_vs_baseline": round(scan_cpu / scan_tpu, 3),

@@ -34,6 +34,29 @@ fi
 echo "== full test suite"
 python -m pytest tests/ -q
 
+echo "== bench smoke (tiny rows, CPU backend): JSON must parse and carry"
+echo "   the data-plane fields (donated_bytes / h2d_gb_per_sec / ...)"
+BENCH_ROWS=4096 BENCH_PARTS=1 BENCH_PLATFORM=cpu BENCH_BACKEND_WAIT_SECS=120 \
+BENCH_REPIN=1 python - << 'PY'
+import json
+import subprocess
+import sys
+
+out = subprocess.run([sys.executable, "bench.py"], capture_output=True,
+                     text=True, timeout=600)
+assert out.returncode == 0, f"bench.py failed:\n{out.stderr[-3000:]}"
+lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+assert lines, f"no JSON line in bench output:\n{out.stdout[-2000:]}"
+j = json.loads(lines[-1])
+for key in ("value", "donated_bytes", "h2d_gb_per_sec", "d2h_gb_per_sec",
+            "async_partitions", "dispatch_count"):
+    assert key in j, f"bench JSON missing {key}: {sorted(j)}"
+assert j["value"] > 0, j
+print("bench smoke ok:", {k: j[k] for k in (
+    "value", "donated_bytes", "h2d_gb_per_sec", "d2h_gb_per_sec",
+    "async_partitions")})
+PY
+
 echo "== single-chip entry compile check"
 python - << 'PY'
 import jax

@@ -372,24 +372,51 @@ def host_column_to_device(col: HostColumn, capacity: int,
 
 def host_to_device(batch: HostBatch, capacity: Optional[int] = None,
                    device=None) -> ColumnBatch:
+    import time
+
+    from spark_rapids_tpu.utils.compile_registry import record_transfer
+    t0 = time.monotonic_ns()
     cap = capacity if capacity is not None else round_up_capacity(batch.num_rows)
     cols = [host_column_to_device(c, cap, device) for c in batch.columns]
     num_rows = jnp.asarray(batch.num_rows, dtype=jnp.int32)
     if device is not None:
         num_rows = jax.device_put(num_rows, device)
-    return ColumnBatch(batch.schema, cols, num_rows, cap)
+    out = ColumnBatch(batch.schema, cols, num_rows, cap)
+    nbytes = sum(getattr(leaf, "nbytes", 0)
+                 for leaf in jax.tree_util.tree_leaves(out))
+    # enqueue-side wall: device_put is async on real TPUs, so h2dTimeNs
+    # is host-pack + transfer-enqueue time (h2d_gb_per_sec reads as an
+    # upper bound there; exact on the synchronous CPU backend).  Blocking
+    # here for accuracy would serialize staging against device compute —
+    # the overlap this layer exists to create (same lower-bound policy as
+    # dispatch wall vs. metrics.detailEnabled).
+    record_transfer("h2d", nbytes, time.monotonic_ns() - t0)
+    return out
 
 
 def device_to_host_many(batches: Sequence[ColumnBatch]) -> List[HostBatch]:
-    # ONE bulk device_get for all batches: jax prefetches every leaf with
-    # copy_to_host_async before blocking, so all buffers ride a single
-    # round trip.  Per-column gets serialize one RTT each — over a tunneled
-    # device that dominated query wall time (see profile_bench.py).
+    # ONE bulk device_get for all batches' buffers AND num_rows scalars:
+    # jax prefetches every leaf with copy_to_host_async before blocking, so
+    # the whole pytree rides a single sync + round trip.  Per-column gets
+    # serialize one RTT each — over a tunneled device that dominated query
+    # wall time (see profile_bench.py).
+    import time
+
+    from spark_rapids_tpu.utils.compile_registry import (
+        guard_check, record_transfer,
+    )
+    guard_check(list(batches), "device_to_host_many")
+    t0 = time.monotonic_ns()
     host = jax.device_get([
         (b.num_rows,
          [(c.data, c.validity, c.offsets) if c.offsets is not None
           else (c.data, c.validity) for c in b.columns])
         for b in batches])
+    nbytes = sum(
+        buf.nbytes
+        for _num_rows, col_bufs in host
+        for bufs in col_bufs for buf in bufs)
+    record_transfer("d2h", nbytes, time.monotonic_ns() - t0)
     out = []
     for batch, (num_rows, col_bufs) in zip(batches, host):
         n = int(num_rows)
@@ -397,20 +424,27 @@ def device_to_host_many(batches: Sequence[ColumnBatch]) -> List[HostBatch]:
         for f, bufs in zip(batch.schema.fields, col_bufs):
             validity = np.asarray(bufs[1])[:n]
             if f.dtype.is_string:
-                data = np.asarray(bufs[0])
+                # one bytes() copy + per-row slicing of it: slicing a bytes
+                # object is a cheap memcpy, vs. the per-row ndarray slice +
+                # bytes() pair this replaced (2 object allocs + dtype
+                # machinery per row)
                 offsets = np.asarray(bufs[2])
+                raw = np.asarray(bufs[0]).tobytes()
                 values = np.empty(n, dtype=object)
                 for i in range(n):
-                    values[i] = bytes(
-                        data[offsets[i]:offsets[i + 1]]).decode(
+                    values[i] = raw[offsets[i]:offsets[i + 1]].decode(
                         "utf-8", errors="replace")
                 out_cols.append(HostColumn(f.dtype, values, validity))
             elif f.dtype.is_array:
                 data = np.asarray(bufs[0])
                 offsets = np.asarray(bufs[2])
                 values = np.empty(n, dtype=object)
-                for i in range(n):
-                    values[i] = list(data[offsets[i]:offsets[i + 1]])
+                if n:
+                    # one vectorized split at the live offsets instead of
+                    # n fancy-indexed copies
+                    for i, seg in enumerate(np.split(
+                            data[:offsets[n]], offsets[1:n])):
+                        values[i] = list(seg)
                 out_cols.append(HostColumn(f.dtype, values, validity))
             else:
                 data = np.asarray(bufs[0])[:n]
@@ -430,6 +464,8 @@ def host_sizes(batches: Sequence[ColumnBatch]) -> List[Tuple[int, List[int]]]:
     String byte totals read ``offsets[-1]`` — valid because offsets are
     constant past num_rows by construction.
     """
+    from spark_rapids_tpu.utils.compile_registry import guard_check
+    guard_check(list(batches), "host_sizes")
     scalars = [(b.num_rows,
                 [c.offsets[-1] for c in b.columns if c.is_varlen])
                for b in batches]

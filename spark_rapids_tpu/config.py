@@ -349,6 +349,23 @@ PIPELINE_FUSE_TAIL = conf_bool(
     "stage program: the final merge-aggregate/sort/limit tail then runs "
     "in one jitted dispatch instead of shrink + tail (lower dispatchCount "
     "per query; the tail program is cached per shrunk-bucket signature).")
+PIPELINE_ASYNC_PARTITIONS = conf_bool(
+    "spark.rapids.sql.tpu.pipeline.asyncPartitions.enabled", True,
+    "Dispatch every pipeline source's stage program (and every collected "
+    "partition's work) before taking any blocking host sync, then batch "
+    "the stage-break size syncs and the final device->host copy into one "
+    "round trip each.  Off restores the sequential "
+    "dispatch/sync-per-source order.")
+DONATION_ENABLED = conf_bool(
+    "spark.rapids.sql.tpu.donation.enabled", True,
+    "Donate consumed input buffers to the stage programs and stage-break "
+    "shrink gathers (jax donate_argnums): XLA reuses the input HBM for "
+    "outputs instead of holding input + output live across the dispatch. "
+    "Only buffers the engine provably never touches again are donated "
+    "(fresh host->device stagings and stage-break intermediates — never "
+    "cached or spill-catalog batches); a donated dispatch that hits a "
+    "device OOM fails fast instead of spill-retrying, since its inputs "
+    "are already consumed.")
 PIPELINE_SHRINK_BYTES = conf_bytes(
     "spark.rapids.sql.tpu.pipeline.shrinkBytes", 4 << 20,
     "Padded stage outputs at or below this byte total skip the sizes "

@@ -67,7 +67,10 @@ def arrow_to_host_batch(table_or_batch, schema: Optional[T.Schema] = None
                 arr.chunk(0)
         if pa.types.is_dictionary(arr.type):
             arr = arr.dictionary_decode()
-        validity = np.asarray(arr.is_valid())
+        null_free = arr.null_count == 0
+        # null-free columns skip the bit-unpacking is_valid() pass
+        validity = np.ones(len(arr), dtype=np.bool_) if null_free \
+            else np.asarray(arr.is_valid())
         if f.dtype.is_string:
             values = np.array(
                 ["" if v is None else v for v in arr.to_pylist()],
@@ -79,11 +82,22 @@ def arrow_to_host_batch(table_or_batch, schema: Optional[T.Schema] = None
                 "datetime64[us]").astype(np.int64)
             values = np.where(validity, values, 0).astype(np.int64)
         else:
-            values = arr.to_numpy(zero_copy_only=False)
+            values = None
+            if null_free:
+                # zero-copy view over the arrow buffer for contiguous
+                # null-free numerics: the scan's read-ahead then feeds H2D
+                # staging without an intermediate host copy (bit-packed
+                # bools and anything non-contiguous raise and fall through)
+                try:
+                    values = arr.to_numpy(zero_copy_only=True)
+                except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+                    values = None
+            if values is None:
+                values = arr.to_numpy(zero_copy_only=False)
             if values.dtype.kind == "f" and not f.dtype.is_fractional:
                 # arrow promotes nullable ints to float NaN; undo it
                 values = np.where(validity, np.nan_to_num(values), 0)
-            values = values.astype(f.dtype.np_dtype)
+            values = values.astype(f.dtype.np_dtype, copy=False)
         cols.append(HostColumn(f.dtype, values, validity))
     return HostBatch(schema, cols)
 

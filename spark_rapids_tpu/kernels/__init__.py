@@ -14,11 +14,15 @@ Design rules (see batch.py / SURVEY.md section 7):
   two-phase pattern: a jitted sizing pass returns scalar counts, the host
   buckets them to a power-of-two capacity, and a second jitted pass runs with
   that static capacity.  The compile cache amortizes this across batches;
-* row movement is always *gather* (never scatter) so XLA can fuse freely.
+* row movement prefers *gather* so XLA can fuse freely; the exceptions
+  (compaction ranking, k-way concat) are single-pass scatters with
+  genuinely unique indices so XLA emits plain scatters, not sort-based
+  ones.
 """
 
 from spark_rapids_tpu.kernels.layout import (
     compact,
+    concat_kway,
     concat_pair,
     gather_rows,
     take_head,

@@ -145,6 +145,112 @@ def take_head(batch: ColumnBatch, limit) -> ColumnBatch:
     return ColumnBatch(batch.schema, batch.columns, n, batch.capacity)
 
 
+def concat_kway(batches: Sequence[ColumnBatch], out_capacity: int,
+                out_byte_caps: Optional[Sequence[int]] = None) -> ColumnBatch:
+    """Concatenate k batches (same schema) into ONE output allocation.
+
+    The pairwise chain materializes k-1 growing intermediates, each a full
+    read+write of everything concatenated so far — O(k * out_capacity) HBM
+    traffic.  Here every input is written exactly ONCE at its row (and, for
+    varlen columns, byte) offset: per input j, a scatter places its live
+    rows at ``sum(num_rows[:j]) + i``; dead rows target genuinely unique
+    out-of-bounds slots (``out_capacity + i``) so ``mode="drop"`` discards
+    them while the ``unique_indices`` promise stays true and XLA emits a
+    plain scatter (see :func:`compaction_indices`).
+
+    Bit-identical to the :func:`concat_pair` chain: rows packed in input
+    order, zeros past the live rows, varlen offsets rebuilt from one cumsum
+    of the scattered live lengths (constant past the live total).  Safe
+    inside ``jax.jit``; ``out_byte_caps`` defaults to the summed input byte
+    capacities, matching the chain's accumulated default.
+    """
+    assert batches
+    if len(batches) == 1:
+        return batches[0]
+    schema = batches[0].schema
+    for b in batches[1:]:
+        assert b.schema == schema, f"{b.schema} != {schema}"
+    ns = [b.num_rows for b in batches]
+    row_offs = []
+    acc = jnp.asarray(0, jnp.int32)
+    for n in ns:
+        row_offs.append(acc)
+        acc = acc + n
+    total = acc.astype(jnp.int32)
+
+    def scatter_rows(init, values_per_batch):
+        out = init
+        for j, (b, vals) in enumerate(zip(batches, values_per_batch)):
+            iota = jnp.arange(b.capacity, dtype=jnp.int32)
+            tgt = jnp.where(iota < ns[j], row_offs[j] + iota,
+                            out_capacity + iota)
+            out = out.at[tgt].set(vals, mode="drop", unique_indices=True)
+        return out
+
+    cols = []
+    str_i = 0
+    for ci, f in enumerate(schema.fields):
+        parts = [b.columns[ci] for b in batches]
+        validity = scatter_rows(jnp.zeros(out_capacity, dtype=jnp.bool_),
+                                [c.validity for c in parts])
+        if parts[0].is_varlen:
+            bcap = (out_byte_caps[str_i] if out_byte_caps is not None
+                    else sum(int(c.data.shape[0]) for c in parts))
+            str_i += 1
+            lens = scatter_rows(jnp.zeros(out_capacity, dtype=jnp.int32),
+                                [_string_lengths(c) for c in parts])
+            new_offsets = jnp.concatenate([
+                jnp.zeros(1, dtype=jnp.int32),
+                jnp.cumsum(lens).astype(jnp.int32),
+            ])
+            data = jnp.zeros(bcap, dtype=parts[0].data.dtype)
+            byte_off = jnp.asarray(0, jnp.int32)
+            for c, n in zip(parts, ns):
+                # LIVE bytes only (offsets[num_rows], not offsets[-1]):
+                # take_head truncates num_rows without repacking, so dead
+                # rows keep growing offsets — their bytes must neither
+                # advance the cursor nor overwrite the next input's region
+                nbytes_j = c.offsets[n]
+                biota = jnp.arange(int(c.data.shape[0]), dtype=jnp.int32)
+                tgt = jnp.where(biota < nbytes_j, byte_off + biota,
+                                bcap + biota)
+                data = data.at[tgt].set(c.data, mode="drop",
+                                        unique_indices=True)
+                byte_off = byte_off + nbytes_j
+            cols.append(DeviceColumn(f.dtype, data, validity, new_offsets))
+        else:
+            data = scatter_rows(
+                jnp.zeros(out_capacity, dtype=parts[0].data.dtype),
+                [c.data for c in parts])
+            cols.append(DeviceColumn(f.dtype, data, validity, None))
+    return ColumnBatch(schema, cols, total, out_capacity)
+
+
+def _concat_kway_tuple(batches, out_capacity, out_byte_caps):
+    return concat_kway(list(batches), out_capacity,
+                       list(out_byte_caps) if out_byte_caps else None)
+
+
+def concat_kway_run(batches: Sequence[ColumnBatch], out_capacity: int,
+                    out_byte_caps: Optional[Sequence[int]] = None
+                    ) -> ColumnBatch:
+    """Eager-path entry: ONE compiled dispatch for the whole k-way concat
+    (the pairwise chain ran as an eager op storm).  Cached per
+    (input shape-bucket tuple, output caps) like every instrumented jit."""
+    from spark_rapids_tpu.utils.compile_registry import instrumented_jit
+    global _CONCAT_KWAY_JIT
+    if _CONCAT_KWAY_JIT is None:
+        _CONCAT_KWAY_JIT = instrumented_jit(
+            _concat_kway_tuple, label="kernels:concatKway",
+            static_argnames=("out_capacity", "out_byte_caps"))
+    return _CONCAT_KWAY_JIT(
+        tuple(batches), out_capacity,
+        tuple(out_byte_caps) if out_byte_caps else None)
+
+
+_CONCAT_KWAY_JIT = None
+
+
 def concat_pair(a: ColumnBatch, b: ColumnBatch, out_capacity: int,
                 out_byte_caps: Optional[Sequence[int]] = None) -> ColumnBatch:
     """Concatenate two batches (same schema) into one of static capacity.

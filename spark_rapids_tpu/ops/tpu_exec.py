@@ -28,7 +28,7 @@ from spark_rapids_tpu.exprs.base import DevVal, Expression, SortOrder, TpuEvalCt
 from spark_rapids_tpu.kernels.groupby import groupby_aggregate
 from spark_rapids_tpu.kernels.join import cross_join, hash_join
 from spark_rapids_tpu.kernels.layout import (
-    compact, concat_pair, gather_rows, take_head,
+    compact, gather_rows, take_head,
 )
 from spark_rapids_tpu.kernels.sort import sort_batch
 from spark_rapids_tpu.plan.physical import ExecContext, PhysicalOp, TpuExec
@@ -86,12 +86,16 @@ def _concat_all(batches: List[ColumnBatch], schema: T.Schema,
     """Concatenate a partition's batches into one (RequireSingleBatch goal,
     GpuCoalesceBatches.scala:105-110).  Sizes the output by host-visible
     totals, fetched in ONE round trip for all batches (or passed in
-    pre-fetched via ``sizes``)."""
+    pre-fetched via ``sizes``); the k-way kernel then writes every input
+    once into a single output allocation and the whole concat rides ONE
+    compiled dispatch (the pairwise chain dispatched an eager op storm
+    and materialized k-1 growing intermediates)."""
     if not batches:
         return None
     if len(batches) == 1:
         return batches[0]
     from spark_rapids_tpu.batch import colocate_batches, host_sizes
+    from spark_rapids_tpu.kernels.layout import concat_kway_run
     batches = list(colocate_batches(batches))
     if sizes is None:
         sizes = host_sizes(batches)
@@ -103,11 +107,7 @@ def _concat_all(batches: List[ColumnBatch], schema: T.Schema,
         round_up_capacity(max(sum(s[1][j] for s in sizes), 16), minimum=16)
         for j in range(n_str)
     ]
-    acc = batches[0]
-    for nxt in batches[1:]:
-        acc = concat_pair(acc, nxt, cap,
-                          out_byte_caps=byte_caps or None)
-    return acc
+    return concat_kway_run(batches, cap, out_byte_caps=byte_caps or None)
 
 
 class TpuRangeExec(TpuExec):
@@ -549,8 +549,14 @@ class TpuHashAggregateExec(TpuExec):
 
         self._run = run
         self._run_hash = run_hash
+        # the merge input is always a fresh >1-way concat this exec built
+        # (never a cached/spill-held batch) and is consumed here: donate
+        # its buffers so concat + merge don't hold two full copies
         self._merge_run = instrumented_jit(self._merge_partials,
                                            label="TpuHashAggregate:merge")
+        self._merge_run_donate = instrumented_jit(
+            self._merge_partials, label="TpuHashAggregate:merge",
+            donate_argnums=(0,))
         self._input_fns = []
 
     def absorb_input(self, fns):
@@ -594,6 +600,12 @@ class TpuHashAggregateExec(TpuExec):
         if self.mode == "update" and self._hash_active(ctx):
             return "hash"
         return "sort"
+
+    def stage_may_rerun(self, ctx) -> bool:
+        """The MXU update stage's epilogue may re-dispatch the exact sort
+        variant on the SAME materialized inputs — the pipeline must not
+        donate them (plan/pipeline._stage_may_rerun)."""
+        return self.mode == "update" and self._hash_active(ctx)
 
     def pipeline_inline(self, ctx, build):
         from spark_rapids_tpu.plan.pipeline import concat_static
@@ -803,6 +815,7 @@ class TpuHashAggregateExec(TpuExec):
             # buffers (no per-batch host sync); the downstream pipeline
             # break right-sizes them in one round trip.
             def gen(part):
+                from spark_rapids_tpu.plan.pipeline import _donation_enabled
                 batches = list(part)
                 partials = self._update_partials(ctx, batches)
                 if not partials:
@@ -811,7 +824,9 @@ class TpuHashAggregateExec(TpuExec):
                     yield partials[0]
                     return
                 merged = _concat_all(partials, self.output_schema)
-                yield self._merge_run(merged)
+                run = self._merge_run_donate if _donation_enabled(ctx) \
+                    else self._merge_run
+                yield run(merged)
 
         return [gen(p) for p in self.children[0].partitions(ctx)]
 

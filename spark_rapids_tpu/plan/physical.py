@@ -12,6 +12,8 @@ CPU<->TPU boundary (GpuTransitionOverrides analogue).
 
 from __future__ import annotations
 
+import collections
+import threading
 import time
 from typing import Callable, Dict, Iterator, List, Optional
 
@@ -124,6 +126,57 @@ class CpuExec(PhysicalOp):
     is_tpu = False
 
 
+class _ReadAheadChannel:
+    """Bounded staging channel for the read-ahead worker: put/get wait on a
+    condition variable AND wake immediately on :meth:`stop` — the
+    queue.Full poll loop this replaced re-armed a 0.25 s timeout on every
+    back-pressure wait, so worker shutdown and a full queue both paid a
+    polling tail latency.
+
+    ``put`` returns False once stopped (the consumer has left: the item is
+    dropped, never stranded).  ``get`` returns the sentinel ``None`` when
+    stopped-and-drained.
+    """
+
+    def __init__(self, depth: int):
+        self._items = collections.deque()
+        self._depth = max(1, depth)
+        self._cond = threading.Condition()
+        self._stopped = False
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopped
+
+    def put(self, item) -> bool:
+        with self._cond:
+            while not self._stopped and len(self._items) >= self._depth:
+                self._cond.wait()
+            if self._stopped:
+                return False
+            self._items.append(item)
+            self._cond.notify_all()
+            return True
+
+    def get(self):
+        with self._cond:
+            while not self._stopped and not self._items:
+                self._cond.wait()
+            if self._items:
+                item = self._items.popleft()
+                self._cond.notify_all()
+                return item
+            return None
+
+    def stop(self) -> None:
+        """Drain + wake everyone: blocked producers return False from
+        ``put`` immediately instead of after a poll interval."""
+        with self._cond:
+            self._stopped = True
+            self._items.clear()
+            self._cond.notify_all()
+
+
 class HostToDeviceExec(TpuExec):
     """Stage host batches into HBM (GpuRowToColumnarExec /
     HostColumnarToGpu analogue: acquire semaphore, bulk-copy to device)."""
@@ -177,45 +230,32 @@ class HostToDeviceExec(TpuExec):
             # consumer's device compute — the reference's read-ahead pool
             # + semaphore shape (GpuParquetScan.scala:647-700) without a
             # dedicated stream: jax dispatch is async, the thread only
-            # pays the host-side copy/transfer-enqueue cost.
-            import queue
-            import threading
+            # pays the host-side copy/transfer-enqueue cost.  Producer
+            # back-pressure and shutdown ride the channel's condition
+            # variable, so neither pays a poll interval.
             from spark_rapids_tpu.runtime.device import DeviceRuntime
             catalog = DeviceRuntime.get(ctx.conf).catalog
-            q: "queue.Queue" = queue.Queue(maxsize=depth)
-            stop = threading.Event()
+            chan = _ReadAheadChannel(depth)
             DONE = object()
-
-            def put_bounded(item):
-                # never a blocking put: a consumer that already left its
-                # finally-drain must not strand the worker (it would hold
-                # generator/device state past the query)
-                while not stop.is_set():
-                    try:
-                        q.put(item, timeout=0.25)
-                        return True
-                    except queue.Full:
-                        continue
-                return False
 
             def worker():
                 try:
                     for hb in part:
-                        if stop.is_set():
+                        if chan.stopped:
                             return
-                        if not put_bounded(("b", stage_nosem(hb, catalog))):
+                        if not chan.put(("b", stage_nosem(hb, catalog))):
                             return
-                    put_bounded(DONE)
+                    chan.put((DONE, None))
                 except BaseException as e:  # surfaced on the consumer side
-                    put_bounded(("e", e))
+                    chan.put(("e", e))
 
             t = threading.Thread(target=worker, daemon=True,
                                  name="stage-readahead")
             t.start()
             try:
                 while True:
-                    item = q.get()
-                    if item is DONE:
+                    item = chan.get()
+                    if item is None or item[0] is DONE:
                         return
                     kind, v = item
                     if kind == "e":
@@ -227,18 +267,12 @@ class HostToDeviceExec(TpuExec):
                         ctx.semaphore.acquire()
                     yield v
             finally:
-                stop.set()
-                try:
-                    while True:
-                        q.get_nowait()
-                except queue.Empty:
-                    pass
-                # Reap the worker (bounded): a worker wedged inside a
-                # device transfer would otherwise outlive the query and
-                # leak its generator state into the next test/query —
-                # the cross-suite-state-leak shape.  The drain above
-                # unblocks any q.put wait, so a healthy worker exits
-                # within the put timeout.
+                # Wake + reap the worker (bounded): stop() drains the
+                # channel and releases any blocked put immediately; a
+                # worker wedged inside a device transfer would otherwise
+                # outlive the query and leak its generator state into the
+                # next test/query — the cross-suite-state-leak shape.
+                chan.stop()
                 t.join(timeout=5.0)
 
         mk = gen_pipelined if depth > 0 else gen
@@ -292,6 +326,77 @@ def run_partition_with_retry(root: PhysicalOp, ctx: ExecContext,
     raise last_err
 
 
+def _drive_partitions(root: PhysicalOp, ctx: ExecContext,
+                      release_partial: bool) -> List:
+    """Drive every partition of ``root`` (trace range, MemoryError
+    pass-through, per-partition retry, collect/batches metric) into one
+    flat batch list — shared by the bulk and iterator collect paths.
+
+    ``release_partial=True`` (bulk path, where the semaphore release for
+    a batch happens only after the final D2H): a partition attempt that
+    fails after yielding k batches must release those k H2D-side acquires
+    before the retry re-acquires for its own batches, or the depth leaks
+    for the process lifetime.  The iterator path releases incrementally
+    per converted batch (DeviceToHostExec), so it must NOT double-release
+    here.
+    """
+    from spark_rapids_tpu.utils.tracing import trace_range
+    flat: List = []
+    for i, part in enumerate(root.partitions(ctx)):
+        got: List = []
+        try:
+            with trace_range(f"partition:{i}"):
+                for b in part:
+                    got.append(b)
+        except BaseException as e:
+            if release_partial and ctx.semaphore is not None:
+                for _ in got:
+                    ctx.semaphore.release()
+            if isinstance(e, MemoryError) or \
+                    not isinstance(e, Exception):
+                # MemoryError passes to the caller's handler;
+                # KeyboardInterrupt/SystemExit must never be swallowed
+                # by a successful retry
+                raise
+            got = run_partition_with_retry(root, ctx, i)
+        flat.extend(got)
+        ctx.metric("collect", "batches").add(len(got))
+    return flat
+
+
+def _collect_device_bulk(root: PhysicalOp, ctx: ExecContext
+                         ) -> List[HostBatch]:
+    """Async-overlapped collect of a TPU root: EVERY partition's device
+    work is dispatched first (jax dispatch is async — the device pipelines
+    across partitions instead of idling at each partition's D2H), then one
+    batched sizes sync right-sizes all batches and ONE bulk transfer
+    brings them home (the DeviceToHostExec iterator paid a sizes sync + a
+    blocking copy per batch, serializing dispatch behind each round trip).
+    """
+    from spark_rapids_tpu.batch import device_to_host_many, host_sizes
+    from spark_rapids_tpu.ops.tpu_exec import shrink_to_fit
+    flat = _drive_partitions(root, ctx, release_partial=True)
+    try:
+        if not flat:
+            return []
+        sizes = host_sizes(flat)
+        shrunk = [shrink_to_fit(b, sizes=s) for b, s in zip(flat, sizes)]
+        return [hb for hb in device_to_host_many(shrunk) if hb.num_rows]
+    finally:
+        # results left the device (or the sizes/D2H step failed — either
+        # way this collect is done with them): release once per collected
+        # batch, pairing with the H2D-side acquires (DeviceToHostExec's
+        # role in the iterator path)
+        if ctx.semaphore is not None:
+            for _ in flat:
+                ctx.semaphore.release()
+
+
+def _async_collect_enabled(ctx: ExecContext) -> bool:
+    from spark_rapids_tpu.config import PIPELINE_ASYNC_PARTITIONS
+    return PIPELINE_ASYNC_PARTITIONS.get(ctx.conf)
+
+
 def collect_host(op: PhysicalOp, ctx: ExecContext) -> HostBatch:
     """Drive a plan to completion and concatenate all partitions on host."""
     from spark_rapids_tpu.utils.tracing import trace_range
@@ -303,20 +408,20 @@ def collect_host(op: PhysicalOp, ctx: ExecContext) -> HostBatch:
                 hb = pipeline_collect(op, ctx)
             if hb is not None:
                 return hb
+            if _async_collect_enabled(ctx):
+                t0 = time.monotonic()
+                batches = _collect_device_bulk(op, ctx)
+                ctx.metric("collect", "wallTimeNs").add(
+                    int((time.monotonic() - t0) * 1e9))
+                if not batches:
+                    return HostBatch(op.output_schema, [
+                        _empty_host_col(f) for f in op.output_schema.fields
+                    ])
+                return HostBatch.concat(batches)
         root = op if not op.is_tpu else DeviceToHostExec(op)
-        batches: List[HostBatch] = []
         t0 = time.monotonic()
-        parts = root.partitions(ctx)
-        for i, part in enumerate(parts):
-            try:
-                with trace_range(f"partition:{i}"):
-                    got = list(part)
-            except MemoryError:
-                raise
-            except Exception:
-                got = run_partition_with_retry(root, ctx, i)
-            batches.extend(got)
-            ctx.metric("collect", "batches").add(len(got))
+        batches: List[HostBatch] = _drive_partitions(
+            root, ctx, release_partial=False)
         ctx.metric("collect", "wallTimeNs").add(
             int((time.monotonic() - t0) * 1e9))
         if not batches:

@@ -25,6 +25,14 @@ Ops that cannot be inlined (host transitions, joins needing host-visible
 output sizing, samples with host RNG) become pipeline *sources*: their
 iterator path materializes batches that feed the program as arguments.
 
+Data-plane economics (docs/dataplane.md): consumed source batches —
+stage-break intermediates and fresh host->device stagings — are DONATED
+to the stage program (``donate_argnums``), so XLA reuses their HBM for
+outputs instead of holding two full copies; with
+``spark.rapids.sql.tpu.pipeline.asyncPartitions.enabled`` every source's
+program is dispatched before any blocking sync and all stage-break size
+fetches ride one batched round trip.
+
 Every stage program dispatch is counted and device-timed
 (utils/compile_registry + utils/tracing), feeding the per-query
 ``dispatchCount`` / ``compileCount`` / ``deviceTimeNs`` metrics.
@@ -50,8 +58,11 @@ from spark_rapids_tpu.utils.tracing import device_dispatch
 def concat_static(batches: List[ColumnBatch], schema: T.Schema
                   ) -> ColumnBatch:
     """In-jit concatenation: output capacity = sum of input *capacities*
-    (static — no host sync).  Stage breaks pay the padding back."""
-    from spark_rapids_tpu.kernels.layout import concat_pair
+    (static — no host sync).  Stage breaks pay the padding back.  One
+    single-allocation k-way kernel writes each input once at its offset;
+    the pairwise chain this replaced materialized k-1 growing
+    intermediates inside the program (O(k * out_capacity) HBM traffic)."""
+    from spark_rapids_tpu.kernels.layout import concat_kway
     if len(batches) == 1:
         return batches[0]
     cap = round_up_capacity(sum(b.capacity for b in batches))
@@ -60,10 +71,7 @@ def concat_static(batches: List[ColumnBatch], schema: T.Schema
         if f.dtype.is_string or f.dtype.is_array:
             byte_caps.append(BUCKETS.elems(
                 sum(int(b.columns[i].data.shape[0]) for b in batches)))
-    acc = batches[0]
-    for nxt in batches[1:]:
-        acc = concat_pair(acc, nxt, cap, out_byte_caps=byte_caps or None)
-    return acc
+    return concat_kway(batches, cap, out_byte_caps=byte_caps or None)
 
 
 def build_pipeline(op: PhysicalOp, ctx: ExecContext,
@@ -103,6 +111,29 @@ def _fuse_tail_enabled(ctx: ExecContext) -> bool:
     return PIPELINE_FUSE_TAIL.get(ctx.conf)
 
 
+def _donation_enabled(ctx: ExecContext) -> bool:
+    from spark_rapids_tpu.config import DONATION_ENABLED
+    from spark_rapids_tpu.utils.compile_registry import donation_supported
+    # donation_supported() guards the fallback where the persistent-cache
+    # bypass could not install and instrumented_jit strips donate_argnums:
+    # the "donating" jits then don't donate, and treating them as donating
+    # here would needlessly disable the OOM spill-retry (retryable=False)
+    return DONATION_ENABLED.get(ctx.conf) and donation_supported()
+
+
+def _async_partitions(ctx: ExecContext) -> bool:
+    from spark_rapids_tpu.config import PIPELINE_ASYNC_PARTITIONS
+    return PIPELINE_ASYNC_PARTITIONS.get(ctx.conf)
+
+
+def _stage_may_rerun(root: PhysicalOp, ctx: ExecContext) -> bool:
+    """True when the stage's epilogue may re-dispatch on the SAME
+    materialized inputs (hash-agg exact fallback): those inputs must then
+    never be donated."""
+    probe = getattr(root, "stage_may_rerun", None)
+    return bool(probe(ctx)) if probe is not None else False
+
+
 def _batch_padded_bytes(b: ColumnBatch) -> int:
     total = 0
     for c in b.columns:
@@ -124,24 +155,66 @@ def _shrink_gather(b: ColumnBatch, cap: int, bcaps: Tuple[int, ...]
                        out_byte_caps=list(bcaps) or None)
 
 
-@instrumented_jit(label="pipeline:shrink", static_argnames=("caps", "bcapss"))
-def _shrink_jit(bs: Tuple[ColumnBatch, ...], caps: Tuple[int, ...],
-                bcapss: Tuple[Tuple[int, ...], ...]):
+def _shrink_many(bs: Tuple[ColumnBatch, ...], caps: Tuple[int, ...],
+                 bcapss: Tuple[Tuple[int, ...], ...]):
     return tuple(_shrink_gather(b, cap, bcaps)
                  for b, cap, bcaps in zip(bs, caps, bcapss))
 
 
-def _shrink_spec(outs: List[ColumnBatch], ctx: ExecContext):
-    """Per-batch (row cap, varlen byte caps) re-bucketing spec for a stage
-    break's raw outputs — ONE sizes round trip for all batches — or None
-    when the padded total is too small to be worth a shrink."""
-    if not outs or sum(_batch_padded_bytes(b) for b in outs) <= \
-            _shrink_threshold(ctx):
-        return None
-    sizes = host_sizes(outs)
+# Two compiled variants of the stage-break re-bucketing gather: the
+# donating one consumes its inputs (raw stage outputs — nothing else ever
+# references them, and an OOM retry recomputes them from the stage
+# program), so XLA reuses their HBM for the shrunk outputs.
+_shrink_jit = instrumented_jit(
+    _shrink_many, label="pipeline:shrink",
+    static_argnames=("caps", "bcapss"))
+_shrink_jit_donate = instrumented_jit(
+    _shrink_many, label="pipeline:shrink",
+    static_argnames=("caps", "bcapss"), donate_argnums=(0,))
+
+
+def _spec_of(sizes) -> tuple:
+    """(row cap, varlen byte caps) re-bucketing spec from host-fetched
+    (num_rows, [varlen totals]) pairs."""
     return tuple(
         (BUCKETS.rows(n), tuple(BUCKETS.elems(t) for t in totals))
         for n, totals in sizes)
+
+
+def _worth_shrinking(outs: List[ColumnBatch], ctx: ExecContext) -> bool:
+    return bool(outs) and sum(_batch_padded_bytes(b) for b in outs) > \
+        _shrink_threshold(ctx)
+
+
+def _shrink_spec(outs: List[ColumnBatch], ctx: ExecContext):
+    """Per-batch re-bucketing spec for a stage break's raw outputs — ONE
+    sizes round trip for all batches — or None when the padded total is
+    too small to be worth a shrink."""
+    if not _worth_shrinking(outs, ctx):
+        return None
+    return _spec_of(host_sizes(outs))
+
+
+def _apply_shrink(outs: List[ColumnBatch], spec: tuple, ctx: ExecContext,
+                  guard: bool = False) -> List[ColumnBatch]:
+    """One compiled gather re-bucketing every batch to ``spec`` (inputs
+    donated when enabled — they are consumed).  ``guard=True`` runs the
+    dispatch under the OOM→spill→retry guard for call sites not already
+    inside one (standalone stage-break shrinks); a donating shrink still
+    fails fast on OOM — its inputs are consumed at dispatch."""
+    caps = tuple(c for c, _ in spec)
+    bcapss = tuple(bc for _, bc in spec)
+    jit = _shrink_jit_donate if _donation_enabled(ctx) else _shrink_jit
+    if jit is _shrink_jit_donate:
+        leaves = jax.tree_util.tree_leaves(tuple(outs))
+        if len({id(leaf) for leaf in leaves}) != len(leaves):
+            # a duplicated leaf cannot be donated twice
+            jit = _shrink_jit
+    run = lambda: list(jit(tuple(outs), caps, bcapss))  # noqa: E731
+    if guard:
+        return _run_oom_guarded(ctx, run, (outs,),
+                                retryable=jit is _shrink_jit)
+    return run()
 
 
 def _shrink_outputs(outs: List[ColumnBatch], ctx: ExecContext
@@ -151,34 +224,72 @@ def _shrink_outputs(outs: List[ColumnBatch], ctx: ExecContext
     if spec is None:
         return outs
     ctx.metric("pipeline", "shrinks").add(1)
-    caps = tuple(c for c, _ in spec)
-    bcapss = tuple(bc for _, bc in spec)
-    return list(_shrink_jit(tuple(outs), caps, bcapss))
+    return _apply_shrink(outs, spec, ctx)
 
 
-def _materialize_source(src: PhysicalOp, ctx: ExecContext, fuse: bool
-                        ) -> Tuple[List[ColumnBatch], Optional[tuple]]:
-    """Materialize one stage source -> (batches, shrink_spec).
+def _materialize_sources(sources: List[PhysicalOp], ctx: ExecContext,
+                         fuse: bool) -> List[list]:
+    """Materialize every stage source -> [[batches, shrink_spec,
+    donatable], ...].
 
-    Stage-break sources with tail fusion on return their RAW (unshrunk)
-    outputs plus the re-bucketing spec the consumer compiles into its own
-    program; everything else returns spec=None.
+    Dispatch-then-sync: every source's stage program (and iterator path)
+    is driven FIRST; the stage-break sizes fetch — the only blocking host
+    sync — is then taken for ALL sources in one batched ``host_sizes``
+    round trip (asyncPartitions conf; off = one fetch per source, the old
+    order).  With tail fusion on, stage-break sources return RAW outputs
+    plus the re-bucketing spec the consumer compiles into its own program;
+    with it off the shrink gather is dispatched standalone here.
+
+    ``donatable`` marks sources whose batches this stage consumes
+    outright: stage-break intermediates and fresh host->device stagings.
+    Everything else (cached scans, spill-catalog handles, broadcast
+    builds) may be referenced again and must never be donated.
     """
     from spark_rapids_tpu.plan.physical import HostToDeviceExec
-    if getattr(src, "pipeline_stage_break", False):
-        if not fuse:
-            return _run_stage(src, ctx), None
-        outs = _run_stage(src, ctx, shrink=False)
-        spec = _shrink_spec(outs, ctx)
-        if spec is not None:
+    async_on = _async_partitions(ctx)
+    mats: List[list] = []
+    pending: List[Tuple[int, List[ColumnBatch]]] = []
+
+    def resolve(i: int, spec: tuple) -> None:
+        if fuse:
             ctx.metric("pipeline", "fusedShrinks").add(1)
-        return outs, spec
-    batches = []
-    for part in src.partitions(ctx):
-        batches.extend(part)
-    if isinstance(src, HostToDeviceExec):
-        ctx._pipeline_h2d = getattr(ctx, "_pipeline_h2d", 0) + len(batches)
-    return batches, None
+            mats[i][1] = spec
+        else:
+            ctx.metric("pipeline", "shrinks").add(1)
+            mats[i][0] = _apply_shrink(mats[i][0], spec, ctx, guard=True)
+
+    for src in sources:
+        if getattr(src, "pipeline_stage_break", False):
+            outs = _run_stage(src, ctx, shrink=False)
+            mats.append([outs, None, True])
+            if _worth_shrinking(outs, ctx):
+                if async_on:
+                    pending.append((len(mats) - 1, outs))
+                else:
+                    # sync-per-source: sizes fetch (and shrink) taken
+                    # right here, before the next source dispatches —
+                    # the old sequential order the conf's off position
+                    # promises to restore
+                    resolve(len(mats) - 1, _spec_of(host_sizes(outs)))
+        else:
+            batches = []
+            for part in src.partitions(ctx):
+                batches.extend(part)
+            donatable = isinstance(src, HostToDeviceExec)
+            if donatable:
+                ctx._pipeline_h2d = \
+                    getattr(ctx, "_pipeline_h2d", 0) + len(batches)
+            mats.append([batches, None, donatable])
+    if pending:
+        # one sizes round trip across EVERY stage-break source, taken
+        # only after all their programs are in flight
+        flat = [b for _, outs in pending for b in outs]
+        sizes = host_sizes(flat)
+        pos = 0
+        for i, outs in pending:
+            resolve(i, _spec_of(sizes[pos:pos + len(outs)]))
+            pos += len(outs)
+    return mats
 
 
 def _stage_build(root: PhysicalOp, ctx: ExecContext, variant: str):
@@ -196,46 +307,71 @@ def _stage_build(root: PhysicalOp, ctx: ExecContext, variant: str):
 
 
 def _stage_program(root: PhysicalOp, ctx: ExecContext, variant: str,
-                   spec: Optional[tuple]):
-    """(sources, jitted) for (variant, tail-fusion shrink spec).
+                   spec: Optional[tuple], dmask: Tuple[bool, ...]):
+    """(sources, jitted) for (variant, tail-fusion shrink spec, donation
+    mask).
 
     ``spec`` (one entry per source; None = feed raw) bakes the stage-break
     re-bucketing gathers into the stage program's prologue, so shrink +
     tail ride ONE dispatch.  Power-of-two bucketing keeps the number of
     distinct specs — and therefore compiled tail variants — small.
+
+    ``dmask`` (one bool per source) selects which sources' batches are
+    DONATED: the program takes (donated, kept) argument tuples and
+    ``donate_argnums`` hands the donated buffers' HBM to XLA for reuse —
+    a consumed input batch then never holds a second full copy across the
+    dispatch.
     """
     cache = getattr(root, "_stage_cache", None)
     if not isinstance(cache, dict):
         cache = {}
         root._stage_cache = cache
-    key = (variant, spec)
+    key = (variant, spec, dmask)
     if key not in cache:
         sources, fn = _stage_build(root, ctx, variant)
+
+        def assemble(dargs, kargs, _mask=dmask):
+            di, ki, args = 0, 0, []
+            for m in _mask:
+                if m:
+                    args.append(dargs[di])
+                    di += 1
+                else:
+                    args.append(kargs[ki])
+                    ki += 1
+            return tuple(args)
+
         if spec is None or all(s is None for s in spec):
-            run = lambda args: tuple(fn(args))  # noqa: E731
+            def run(dargs, kargs):
+                return tuple(fn(assemble(dargs, kargs)))
         else:
-            def run(args, _spec=spec):
+            def run(dargs, kargs, _spec=spec):
                 shrunk = tuple(
                     tuple(bs) if sp is None else tuple(
                         _shrink_gather(b, cap, bcaps)
                         for b, (cap, bcaps) in zip(bs, sp))
-                    for bs, sp in zip(args, _spec))
+                    for bs, sp in zip(assemble(dargs, kargs), _spec))
                 return tuple(fn(shrunk))
+        jit_kw = {"donate_argnums": (0,)} if any(dmask) else {}
         cache[key] = (sources,
-                      instrumented_jit(run, label=f"stage:{root.name}"))
+                      instrumented_jit(run, label=f"stage:{root.name}",
+                                       **jit_kw))
     return cache[key]
 
 
-def _run_oom_guarded(ctx: ExecContext, thunk, args=()):
+def _run_oom_guarded(ctx: ExecContext, thunk, args=(), retryable=True):
     """Dispatch a stage program under the OOM→spill→retry guard
     (DeviceMemoryEventHandler.scala:35 role; see mem.catalog).  ``args`` —
     the stage's input batches, still referenced by the retry — are pinned
-    so the spill pass doesn't waste a pass "freeing" live buffers."""
+    so the spill pass doesn't waste a pass "freeing" live buffers.
+    ``retryable=False`` (donated inputs: consumed at dispatch, a retry
+    cannot re-present them) fails fast with the original OOM instead."""
     from spark_rapids_tpu.mem.catalog import run_with_oom_retry
     from spark_rapids_tpu.runtime.device import DeviceRuntime
     pinned = [b for bs in args for b in bs]
     return run_with_oom_retry(
-        DeviceRuntime.get(ctx.conf).catalog, thunk, pinned=pinned,
+        DeviceRuntime.get(ctx.conf).catalog, thunk,
+        retries=2 if retryable else 0, pinned=pinned,
         on_retry=lambda _freed: ctx.metric("pipeline", "oom_retries").add(1))
 
 
@@ -248,22 +384,32 @@ def _run_stage(root: PhysicalOp, ctx: ExecContext,
     variant = variant_fn(ctx) if variant_fn is not None else "default"
     fuse = _fuse_tail_enabled(ctx)
     sources, _fn = _stage_build(root, ctx, variant)
-    mats = [_materialize_source(s, ctx, fuse) for s in sources]
-    args = tuple(tuple(bs) for bs, _ in mats)
-    spec = tuple(sp for _, sp in mats) if fuse else None
+    mats = _materialize_sources(sources, ctx, fuse)
+    args = tuple(tuple(bs) for bs, _, _ in mats)
+    spec = tuple(sp for _, sp, _ in mats) if fuse else None
     from spark_rapids_tpu.batch import colocate_batches
     args = tuple(tuple(bs) for bs in colocate_batches(args))
+    donate = _donation_enabled(ctx) and not _stage_may_rerun(root, ctx)
+    dmask = tuple(bool(donate and d) for _, _, d in mats)
+    if any(dmask):
+        leaves = jax.tree_util.tree_leaves(
+            tuple(a for a, m in zip(args, dmask) if m))
+        if len({id(leaf) for leaf in leaves}) != len(leaves):
+            # a duplicated leaf cannot be donated twice — keep everything
+            dmask = tuple(False for _ in dmask)
 
     def dispatch(v: str) -> List[ColumnBatch]:
-        s2, jitted = _stage_program(root, ctx, v, spec)
+        s2, jitted = _stage_program(root, ctx, v, spec, dmask)
         assert len(s2) == len(sources), "stage variants disagree"
         ctx.metric("pipeline", "programs").add(1)
+        dargs = tuple(a for a, m in zip(args, dmask) if m)
+        kargs = tuple(a for a, m in zip(args, dmask) if not m)
         with device_dispatch(ctx, "pipeline", root.name) as holder:
             outs = _run_oom_guarded(
                 ctx,
-                lambda: _shrink_outputs(list(jitted(args)), ctx)
-                if shrink else list(jitted(args)),
-                args)
+                lambda: _shrink_outputs(list(jitted(dargs, kargs)), ctx)
+                if shrink else list(jitted(dargs, kargs)),
+                args, retryable=not any(dmask))
             holder["outputs"] = outs
         return outs
 

@@ -173,6 +173,13 @@ class TpuSparkSession:
         self.last_metrics["dispatchCount"] = d["dispatches"]
         self.last_metrics["backendCompileNs"] = d["backend_compile_ns"]
         self.last_metrics["compiledShapes"] = CR.compiled_shapes()
+        # data-plane economics: input bytes donated to dispatches (HBM
+        # reused for outputs) and the host<->device staging volume/time
+        self.last_metrics["donatedBytes"] = d["donated_bytes"]
+        self.last_metrics["h2dBytes"] = d["h2d_bytes"]
+        self.last_metrics["h2dTimeNs"] = d["h2d_ns"]
+        self.last_metrics["d2hBytes"] = d["d2h_bytes"]
+        self.last_metrics["d2hTimeNs"] = d["d2h_ns"]
         self.last_metrics["deviceTimeNs"] = sum(
             ms["deviceTimeNs"].value for ms in ctx.metrics.values()
             if "deviceTimeNs" in ms)

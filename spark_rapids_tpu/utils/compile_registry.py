@@ -20,6 +20,20 @@ round trip.  This module makes both quantities *measured*:
   cache (conf ``spark.rapids.sql.tpu.compileCacheDir``) so repeated
   processes skip recompilation entirely.
 
+Data-plane accounting rides the same snapshot/delta machinery:
+
+* ``donate_argnums`` passes through :func:`instrumented_jit` to ``jax.jit``
+  and every donated call adds the donated arguments' buffer bytes to
+  ``donated_bytes`` (surfaced as ``session.last_metrics['donatedBytes']``).
+  The :func:`donation_guard` context manager arms a use-after-donate
+  assertion for tests: once a buffer has been donated, presenting it to
+  any later instrumented call (or sync site registered via
+  :func:`guard_check`) raises.
+* :func:`record_transfer` accumulates host<->device staging bytes and
+  wall time (``h2d_bytes``/``h2d_ns``/``d2h_bytes``/``d2h_ns``) from the
+  batch staging layer, feeding bench.py's ``h2d_gb_per_sec`` /
+  ``d2h_gb_per_sec``.
+
 When available, ``jax.monitoring`` backend-compile duration events are
 also accumulated (``backend_compile_ns``) — pure XLA compile seconds,
 excluding the first-run execution that the wall number includes.
@@ -41,6 +55,11 @@ _STATS: Dict[str, int] = {
     "compile_wall_ns": 0,   # wall ns of calls that triggered a compile
     "dispatches": 0,        # jitted program invocations
     "backend_compile_ns": 0,  # jax.monitoring backend compile durations
+    "donated_bytes": 0,     # input buffer bytes donated to dispatches
+    "h2d_bytes": 0,         # host->device staging bytes
+    "h2d_ns": 0,            # host->device staging wall ns
+    "d2h_bytes": 0,         # device->host bulk-copy bytes
+    "d2h_ns": 0,            # device->host bulk-copy wall ns
 }
 _LABEL_COMPILES: Dict[str, int] = {}
 
@@ -71,13 +90,73 @@ def per_label_compiles() -> Dict[str, int]:
         return dict(_LABEL_COMPILES)
 
 
-def _record(label: str, compiled: bool, wall_ns: int) -> None:
+def _record(label: str, compiled: bool, wall_ns: int,
+            donated_bytes: int = 0) -> None:
     with _LOCK:
         _STATS["dispatches"] += 1
+        _STATS["donated_bytes"] += donated_bytes
         if compiled:
             _STATS["compiles"] += 1
             _STATS["compile_wall_ns"] += wall_ns
             _LABEL_COMPILES[label] = _LABEL_COMPILES.get(label, 0) + 1
+
+
+def record_transfer(kind: str, nbytes: int, wall_ns: int) -> None:
+    """Accumulate one host<->device staging pass (kind: "h2d" | "d2h")."""
+    with _LOCK:
+        _STATS[kind + "_bytes"] += int(nbytes)
+        _STATS[kind + "_ns"] += int(wall_ns)
+
+
+# -- use-after-donate guard (tests) ------------------------------------------
+
+# When armed, maps id(array) -> (donating label, strong ref).  The strong
+# ref pins the array object so a GC'd id can never be reused by a fresh
+# buffer and false-positive.
+_DONATION_GUARD: Optional[Dict[int, tuple]] = None
+
+
+class _guard_ctx:
+    def __enter__(self):
+        global _DONATION_GUARD
+        self._prev = _DONATION_GUARD
+        _DONATION_GUARD = {}
+        return _DONATION_GUARD
+
+    def __exit__(self, *exc):
+        global _DONATION_GUARD
+        _DONATION_GUARD = self._prev
+        return False
+
+
+def donation_guard() -> "_guard_ctx":
+    """Context manager arming the use-after-donate assertion: every
+    instrumented dispatch (and every sync site calling :func:`guard_check`)
+    verifies none of its inputs were previously donated."""
+    return _guard_ctx()
+
+
+def guard_check(tree, site: str) -> None:
+    """Assert no leaf of ``tree`` was donated to an earlier dispatch.
+    No-op unless :func:`donation_guard` is armed (hot paths pay one
+    ``is None`` test)."""
+    guard = _DONATION_GUARD
+    if guard is None:
+        return
+    for leaf in jax.tree_util.tree_leaves(tree):
+        hit = guard.get(id(leaf))
+        if hit is not None:
+            raise AssertionError(
+                f"use-after-donate: {site} received a buffer already "
+                f"donated to {hit[0]}")
+
+
+def _guard_mark(label: str, leaves) -> None:
+    guard = _DONATION_GUARD
+    if guard is None:
+        return
+    for leaf in leaves:
+        guard[id(leaf)] = (label, leaf)
 
 
 def _cache_size(jitted) -> int:
@@ -85,6 +164,79 @@ def _cache_size(jitted) -> int:
         return jitted._cache_size()
     except Exception:  # noqa: BLE001 — older/newer jax without the probe
         return -1
+
+
+# -- persistent-cache bypass for donating executables -------------------------
+#
+# XLA:CPU (jax 0.4.37): an executable DESERIALIZED from the persistent
+# compilation cache mishandles input-output aliasing — donated input
+# buffers are freed while the deserialized program still reads them
+# (wrong results and segfaults; reproduced 8/8 with a populated cache,
+# 0/8 with the cache disabled, identical code).  Freshly *compiled*
+# donating executables are sound, so donating programs simply never
+# enter the persistent cache: while a donating dispatch is on the
+# current thread, cache reads return a miss and writes are dropped.
+# Non-donating programs (the vast majority of compile time) keep full
+# persistence.
+
+_NO_PERSIST = threading.local()
+_CACHE_BYPASS_INSTALLED = False
+
+
+class _no_persist_scope:
+    def __enter__(self):
+        _NO_PERSIST.depth = getattr(_NO_PERSIST, "depth", 0) + 1
+
+    def __exit__(self, *exc):
+        _NO_PERSIST.depth -= 1
+        return False
+
+
+def _install_cache_bypass() -> None:
+    global _CACHE_BYPASS_INSTALLED
+    with _LOCK:
+        # under the lock, and the installed flag is only set AFTER the
+        # hooks are swapped: a concurrent donation_supported() must not
+        # see True while cache reads are still live (that window would
+        # re-open the deserialized-donation use-after-free)
+        if _CACHE_BYPASS_INSTALLED:
+            return
+        try:
+            from jax._src import compilation_cache as _cc
+            real_get = _cc.get_executable_and_time
+            real_put = _cc.put_executable_and_time
+
+            @functools.wraps(real_get)
+            def get(*args, **kwargs):
+                if getattr(_NO_PERSIST, "depth", 0):
+                    return None, None
+                return real_get(*args, **kwargs)
+
+            @functools.wraps(real_put)
+            def put(*args, **kwargs):
+                if getattr(_NO_PERSIST, "depth", 0):
+                    return None
+                return real_put(*args, **kwargs)
+
+            _cc.get_executable_and_time = get
+            _cc.put_executable_and_time = put
+        except Exception:  # noqa: BLE001 — private API moved: fall back
+            # to disabling donation outright rather than risk the
+            # use-after-free
+            global _DONATION_FORCED_OFF
+            _DONATION_FORCED_OFF = True
+        _CACHE_BYPASS_INSTALLED = True
+
+
+_DONATION_FORCED_OFF = False
+
+
+def donation_supported() -> bool:
+    """False when the persistent-cache bypass could not be installed (jax
+    private API moved) — donation then stays off everywhere rather than
+    risk cache-deserialized aliasing corruption."""
+    _install_cache_bypass()
+    return not _DONATION_FORCED_OFF
 
 
 def _trace_state_clean() -> bool:
@@ -96,6 +248,24 @@ def _trace_state_clean() -> bool:
         return True
 
 
+_DONATION_WARNING_FILTERED = False
+
+
+def _filter_donation_warning() -> None:
+    """Once per process: a donated input whose shape matches no output
+    can't be aliased in place; jax warns per lowering, but the buffer is
+    still consumed (freed at dispatch) — exactly the intent, so the
+    warning is noise at our opt-in call sites.  One global filter entry,
+    not one per donating jit (every warning check scans the list)."""
+    global _DONATION_WARNING_FILTERED
+    if _DONATION_WARNING_FILTERED:
+        return
+    _DONATION_WARNING_FILTERED = True
+    import warnings
+    warnings.filterwarnings(
+        "ignore", message="Some donated buffers were not usable")
+
+
 def instrumented_jit(fn: Optional[Callable] = None, *, label: str = "",
                      **jit_kwargs) -> Callable:
     """``jax.jit`` with dispatch/compile accounting.
@@ -103,11 +273,19 @@ def instrumented_jit(fn: Optional[Callable] = None, *, label: str = "",
     Usable as ``instrumented_jit(f, label=...)`` or as a decorator
     ``@instrumented_jit(label=..., static_argnames=...)``.  The wrapper is
     call-compatible with the jitted function; the raw jitted callable is
-    exposed as ``wrapper.jitted``.
+    exposed as ``wrapper.jitted``.  ``donate_argnums`` passes through to
+    ``jax.jit``; donated argument bytes are accumulated per dispatch.
     """
     if fn is None:
         return functools.partial(instrumented_jit, label=label, **jit_kwargs)
     name = label or getattr(fn, "__name__", "jit")
+    donate = tuple(jit_kwargs.get("donate_argnums") or ())
+    if donate and not donation_supported():
+        jit_kwargs = {k: v for k, v in jit_kwargs.items()
+                      if k != "donate_argnums"}
+        donate = ()
+    if donate:
+        _filter_donation_warning()
     jitted = jax.jit(fn, **jit_kwargs)
 
     @functools.wraps(fn)
@@ -115,14 +293,34 @@ def instrumented_jit(fn: Optional[Callable] = None, *, label: str = "",
         if not _trace_state_clean():
             # nested call while an outer program is being traced: it
             # inlines into the outer jaxpr, so it is neither a device
-            # dispatch nor a separate compile — don't count it
+            # dispatch nor a separate compile — don't count it (donation
+            # of a traced value is likewise meaningless and ignored)
             return jitted(*args, **kwargs)
+        if _DONATION_GUARD is not None:
+            guard_check((args, kwargs), name)
+        donated_bytes = 0
+        donated_leaves = ()
+        if donate:
+            donated_leaves = [
+                leaf for i in donate if i < len(args)
+                for leaf in jax.tree_util.tree_leaves(args[i])]
+            donated_bytes = sum(
+                getattr(leaf, "nbytes", 0) for leaf in donated_leaves)
         before = _cache_size(jitted)
         t0 = time.monotonic_ns()
-        out = jitted(*args, **kwargs)
+        if donate:
+            # a compile triggered by a donating dispatch must neither read
+            # nor write the persistent cache (deserialized executables
+            # mishandle the donation aliasing — see _install_cache_bypass)
+            with _no_persist_scope():
+                out = jitted(*args, **kwargs)
+        else:
+            out = jitted(*args, **kwargs)
         after = _cache_size(jitted)
         compiled = after >= 0 and after != before
-        _record(name, compiled, time.monotonic_ns() - t0)
+        _record(name, compiled, time.monotonic_ns() - t0, donated_bytes)
+        if donated_leaves:
+            _guard_mark(name, donated_leaves)
         return out
 
     wrapper.jitted = jitted

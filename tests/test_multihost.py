@@ -9,6 +9,19 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
+# Backends that cannot run multiprocess computations surface it as an
+# UNIMPLEMENTED runtime error — an environment limitation of the virtual
+# CPU mesh, not an engine bug, so the dryrun SKIPS instead of failing.
+_UNSUPPORTED_MARKERS = (
+    # deliberately narrow: the bare status code "UNIMPLEMENTED" would also
+    # match genuine engine bugs (an op unsupported only in the
+    # multi-process path) and silently skip the sole multihost test
+    "Multiprocess computations aren't implemented",
+    "multi-process computations aren't implemented",
+)
+
 
 def _free_port() -> int:
     s = socket.socket()
@@ -44,6 +57,14 @@ def test_two_process_multihost_agg():
                 q.kill()
             raise
         outs.append(out)
+    for p, out in zip(procs, outs):
+        if p.returncode != 0 and any(m in out for m in
+                                     _UNSUPPORTED_MARKERS):
+            pytest.skip("backend cannot run multiprocess computations "
+                        "(CPU backend): " +
+                        next(line for line in out.splitlines()
+                             if any(m in line for m in
+                                    _UNSUPPORTED_MARKERS))[:200])
     for p, out in zip(procs, outs):
         assert p.returncode == 0, f"rank failed:\n{out[-3000:]}"
     results = []
