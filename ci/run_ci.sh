@@ -49,12 +49,43 @@ lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
 assert lines, f"no JSON line in bench output:\n{out.stdout[-2000:]}"
 j = json.loads(lines[-1])
 for key in ("value", "donated_bytes", "h2d_gb_per_sec", "d2h_gb_per_sec",
-            "async_partitions", "dispatch_count"):
+            "async_partitions", "dispatch_count",
+            "retry_count", "device_lost_count", "partition_fallbacks",
+            "faults_injected"):
     assert key in j, f"bench JSON missing {key}: {sorted(j)}"
 assert j["value"] > 0, j
 print("bench smoke ok:", {k: j[k] for k in (
     "value", "donated_bytes", "h2d_gb_per_sec", "d2h_gb_per_sec",
-    "async_partitions")})
+    "async_partitions", "retry_count", "device_lost_count")})
+PY
+
+echo "== fault-injection smoke: dispatch:oom@2 must spill-retry and still"
+echo "   produce correct results with retryCount > 0"
+python - << 'PY'
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.session import TpuSparkSession
+
+def make(s):
+    df = s.create_dataframe(
+        {"k": [i % 7 for i in range(4096)],
+         "v": list(range(4096))}, num_partitions=2)
+    return df.group_by("k").sum("v")
+
+clean = TpuSparkSession(RapidsConf({"spark.rapids.sql.enabled": True}))
+want = sorted(make(clean).collect())
+
+s = TpuSparkSession(RapidsConf({
+    "spark.rapids.sql.enabled": True,
+    "spark.rapids.sql.tpu.faults.spec": "dispatch:oom@2",
+}))
+got = sorted(make(s).collect())
+assert got == want, f"faulted run diverged:\n{got[:5]}\n{want[:5]}"
+m = s.last_metrics
+assert m["retryCount"] > 0, m
+assert m["faultsInjected"] >= 1, m
+print("fault smoke ok:", {k: m[k] for k in (
+    "retryCount", "faultsInjected", "deviceLostCount",
+    "partitionFallbackCount", "backoffWallNs")})
 PY
 
 echo "== single-chip entry compile check"

@@ -374,7 +374,9 @@ def host_to_device(batch: HostBatch, capacity: Optional[int] = None,
                    device=None) -> ColumnBatch:
     import time
 
+    from spark_rapids_tpu.fault import inject
     from spark_rapids_tpu.utils.compile_registry import record_transfer
+    inject.maybe_fire("h2d")
     t0 = time.monotonic_ns()
     cap = capacity if capacity is not None else round_up_capacity(batch.num_rows)
     cols = [host_column_to_device(c, cap, device) for c in batch.columns]
@@ -402,9 +404,11 @@ def device_to_host_many(batches: Sequence[ColumnBatch]) -> List[HostBatch]:
     # wall time (see profile_bench.py).
     import time
 
+    from spark_rapids_tpu.fault import inject
     from spark_rapids_tpu.utils.compile_registry import (
         guard_check, record_transfer,
     )
+    inject.maybe_fire("d2h")
     guard_check(list(batches), "device_to_host_many")
     t0 = time.monotonic_ns()
     host = jax.device_get([

@@ -2118,13 +2118,16 @@ LIMIT 100
 Q70 = """
 SELECT total_sum, s_state, ranking
 FROM (
-  SELECT s_state, sum(ss_net_profit) AS total_sum,
-         rank() OVER (ORDER BY sum(ss_net_profit) DESC) AS ranking
-  FROM store_sales
-  JOIN store ON s_store_sk = ss_store_sk
-  JOIN date_dim ON d_date_sk = ss_sold_date_sk
-  WHERE d_year = 1998
-  GROUP BY s_state
+  SELECT s_state, total_sum,
+         rank() OVER (ORDER BY total_sum DESC) AS ranking
+  FROM (
+    SELECT s_state, sum(ss_net_profit) AS total_sum
+    FROM store_sales
+    JOIN store ON s_store_sk = ss_store_sk
+    JOIN date_dim ON d_date_sk = ss_sold_date_sk
+    WHERE d_year = 1998
+    GROUP BY s_state
+  )
 )
 ORDER BY ranking, s_state
 """

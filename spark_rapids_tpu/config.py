@@ -375,6 +375,41 @@ COMPILE_CACHE_DIR = conf_str(
     "Directory for JAX's persistent XLA compilation cache.  When set, "
     "compiled executables survive the process so re-runs (and "
     "session.prewarm()) skip recompilation; empty disables persistence.")
+RETRY_MAX_ATTEMPTS = conf_int(
+    "spark.rapids.sql.tpu.retry.maxAttempts", 3,
+    "Total attempts (first try included) the unified RetryPolicy allows "
+    "a retryable operation: OOM spill-retries, device-lost partition "
+    "replays and whole-pipeline recoveries all share this bound.  "
+    "Exhausted device-class errors degrade to the per-partition CPU "
+    "fallback (fallback.onDeviceError).")
+RETRY_BACKOFF_MS = conf_float(
+    "spark.rapids.sql.tpu.retry.backoffMs", 50.0,
+    "Base backoff milliseconds between retry attempts.  Delays are "
+    "deterministic — backoffMs * 2^(attempt-1), a pure function of the "
+    "attempt index with no jitter — so faulted runs replay identically.")
+PARTITION_TIMEOUT_SEC = conf_float(
+    "spark.rapids.sql.tpu.partition.timeoutSec", 0.0,
+    "Deadline in seconds for driving one partition (and for one "
+    "whole-pipeline stage).  On expiry a watchdog thread raises a "
+    "classified PartitionTimeout into the driving thread — the wedged "
+    "partition then enters device-lost recovery instead of hanging the "
+    "process.  0 disables (the test-tier default; the bench driver "
+    "arms it).")
+FALLBACK_ON_DEVICE_ERROR = conf_bool(
+    "spark.rapids.sql.tpu.fallback.onDeviceError", True,
+    "After retry.maxAttempts device replays of a failed partition "
+    "(device lost, wedged, or OOM that spilling cannot fix), re-run "
+    "just that partition through the CPU operator path so the query "
+    "completes with Spark-CPU-identical results.  false surfaces the "
+    "raw device error instead.")
+FAULTS_SPEC = conf_str(
+    "spark.rapids.sql.tpu.faults.spec", "",
+    "Deterministic fault injection spec, e.g. "
+    "\"dispatch:oom@3;d2h:device_lost@1;spill:slow=200ms@2\": at each "
+    "named site (dispatch, h2d, d2h, spill, exchange) the Nth call "
+    "raises the named error class (or stalls, for slow=<dur>); @N+ "
+    "fires from the Nth call onward.  Call counters reset per query.  "
+    "Empty disables injection.")
 METRICS_DETAIL = conf_bool(
     "spark.rapids.sql.tpu.metrics.detailEnabled", False,
     "Accurate device-time metrics: block on dispatched outputs so "

@@ -1,0 +1,52 @@
+"""Fault-tolerance subsystem.
+
+The reference engine treats fallback-on-failure as co-equal with the
+kernels: anything the GPU cannot finish must still produce the Spark CPU
+answer (SURVEY.md section 5 delegates failure *detection* to Spark task
+retry + lineage).  This package gives the TPU engine the same posture,
+organized in five pieces:
+
+* :mod:`~spark_rapids_tpu.fault.errors` — error taxonomy.  Every raised
+  error classifies as ``RETRYABLE_OOM`` (RESOURCE_EXHAUSTED allocation
+  failures), ``DEVICE_LOST`` (XLA worker crashed/restarted, kernel
+  faults, DATA_LOSS/INTERNAL status codes, partition deadline expiry) or
+  ``NON_RETRYABLE`` (user errors, donated-dispatch OOM,
+  KeyboardInterrupt/SystemExit — never retried).
+* :mod:`~spark_rapids_tpu.fault.retry` — ONE :class:`RetryPolicy`
+  (conf ``spark.rapids.sql.tpu.retry.maxAttempts`` /
+  ``retry.backoffMs``; exponential backoff with deterministic
+  per-attempt delays — no randomness, the delay is a pure function of
+  the attempt index) behind every retry loop in the engine.  The old
+  hand-rolled loops (``mem.catalog.run_with_oom_retry``,
+  ``plan.physical.run_partition_with_retry``) are now thin wrappers.
+* :mod:`~spark_rapids_tpu.fault.watchdog` — per-partition deadline
+  (conf ``spark.rapids.sql.tpu.partition.timeoutSec``): a monitor
+  thread raises a classified :class:`PartitionTimeout` into the driving
+  thread instead of letting a wedged dot hang the suite for 40 minutes
+  (round-5 VERDICT evidence).
+* :mod:`~spark_rapids_tpu.fault.recovery` — device-lost recovery:
+  reset the :class:`DeviceRuntime`, invalidate the spill catalog's
+  device tier (host/disk copies survive and re-upload lazily), replay
+  the failed partition; after ``retry.maxAttempts`` device replays,
+  re-run just that partition through the CPU operator path (conf
+  ``spark.rapids.sql.tpu.fallback.onDeviceError``) so the query still
+  completes with Spark-CPU-identical results — per-partition fallback,
+  never whole-query abort.
+* :mod:`~spark_rapids_tpu.fault.inject` — deterministic fault injection
+  (conf ``spark.rapids.sql.tpu.faults.spec``, e.g.
+  ``"dispatch:oom@3;d2h:device_lost@1;spill:slow=200ms@2"``) wired into
+  the dispatch, h2d, d2h, spill and exchange sites, so every recovery
+  path is exercised in tier-1 without real hardware faults.
+
+Per-query counters (``retryCount``, ``backoffWallNs``,
+``deviceLostCount``, ``partitionFallbackCount``, ``faultsInjected``)
+ride the same snapshot/delta machinery as the compile/dispatch metrics
+(utils.compile_registry) into ``session.last_metrics`` and bench JSON.
+"""
+
+from spark_rapids_tpu.fault.errors import (  # noqa: F401
+    DeviceLostError, ErrorClass, PartitionTimeout, classify_error,
+)
+from spark_rapids_tpu.fault.inject import InjectedFault  # noqa: F401
+from spark_rapids_tpu.fault.retry import RetryPolicy  # noqa: F401
+from spark_rapids_tpu.fault.watchdog import partition_deadline  # noqa: F401

@@ -1,0 +1,234 @@
+"""Device-lost recovery and graceful degradation.
+
+Recovery ladder for a failed partition (or whole-pipeline stage):
+
+1. classify the error (fault.errors);
+2. ``NON_RETRYABLE`` -> re-raise immediately (user errors,
+   donated-dispatch OOM, KeyboardInterrupt);
+3. ``RETRYABLE_OOM`` -> spill everything spillable
+   (catalog.handle_alloc_failure) and replay;
+4. ``DEVICE_LOST`` -> reset the DeviceRuntime (fresh semaphore +
+   device pick, SAME catalog with its device tier invalidated — host
+   and disk copies survive and re-upload lazily), then replay: the
+   partition is a pure recomputation of its lineage (SURVEY.md section
+   5), and the exchange split cache is generation-checked so a replay
+   after a reset recomputes the split instead of reading lost pieces;
+5. after ``retry.maxAttempts`` total attempts on a device-class error,
+   re-run JUST THAT PARTITION through the CPU operator path
+   (ops/cpu_exec, lowered from the query's logical plan with
+   ``spark.rapids.sql.enabled=false``) when
+   ``spark.rapids.sql.tpu.fallback.onDeviceError`` is true — the query
+   completes with Spark-CPU-identical results; per-partition fallback,
+   never whole-query abort.
+
+Per-partition CPU fallback leans on an engine invariant the compare
+harness already enforces: CPU and TPU plans lowered from the same
+logical plan produce identical partition row sets and orders (the
+exchange collapse and partitioning rules are mirrored on both sides).
+When the partition counts nevertheless disagree, fallback degrades to
+whole-query only for single-partition plans and otherwise re-raises the
+device error.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from spark_rapids_tpu.fault import metrics as fault_metrics
+from spark_rapids_tpu.fault.errors import (
+    ErrorClass, PartitionTimeout, classify_error,
+)
+from spark_rapids_tpu.fault.retry import RetryPolicy
+from spark_rapids_tpu.fault.watchdog import partition_deadline
+
+
+def _fallback_enabled(conf) -> bool:
+    from spark_rapids_tpu.config import FALLBACK_ON_DEVICE_ERROR
+    return FALLBACK_ON_DEVICE_ERROR.get(conf)
+
+
+def partition_policy(conf) -> RetryPolicy:
+    """The partition-replay policy: ``retry.maxAttempts`` unless the
+    legacy ``spark.rapids.task.maxFailures`` is explicitly set (it was
+    the knob of the loop this subsystem replaced)."""
+    policy = RetryPolicy.from_conf(conf)
+    legacy = conf._settings.get("spark.rapids.task.maxFailures")
+    if legacy is not None:
+        policy = RetryPolicy(int(legacy), policy.backoff_ms)
+    return policy
+
+
+def recover_device_lost(ctx, err: Optional[BaseException] = None) -> None:
+    """Reset device state after a DEVICE_LOST-class failure.
+
+    * bump the runtime generation + rebuild the DeviceRuntime (fresh
+      semaphore: a wedged permit from the dead attempt cannot block the
+      replay) while KEEPING the spill catalog, its device tier
+      invalidated (mem.catalog.invalidate_device_tier).  A
+      PartitionTimeout-triggered recovery skips the best-effort rescue
+      D2H: the device is WEDGED, and a rescue copy against it would
+      block the recovery path on the very hang being recovered from —
+      device-tier handles go straight to TIER_LOST (lineage recompute);
+    * release every permit the failed attempt still holds on the old
+      semaphore (partitions are driven sequentially, so nothing else in
+      this query is mid-flight), then re-point the query context at the
+      REBUILT runtime: the replay must dispatch to the live device and
+      take admission on the live semaphore, not the dead ones.
+    """
+    from spark_rapids_tpu.runtime.device import DeviceRuntime
+    rescue = not isinstance(err, PartitionTimeout)
+    rt = DeviceRuntime.recover(ctx.conf, rescue=rescue)
+    if ctx.semaphore is not None:
+        ctx.semaphore.release_all()
+        ctx.semaphore = rt.semaphore
+    if ctx.device is not None:
+        ctx.device = rt.device
+
+
+def _pre_replay(ctx, err, cls) -> None:
+    """Recovery action taken before replaying a classified retryable
+    error."""
+    if cls is ErrorClass.DEVICE_LOST:
+        recover_device_lost(ctx, err)
+    elif cls is ErrorClass.RETRYABLE_OOM:
+        from spark_rapids_tpu.runtime.device import DeviceRuntime
+        DeviceRuntime.get(ctx.conf).catalog.handle_alloc_failure()
+
+
+def _recover_loop(ctx, policy: RetryPolicy, attempt: Callable,
+                  fallback: Callable, label: str,
+                  error: Optional[Exception] = None,
+                  attempts_used: int = 0):
+    """The one recovery ladder behind both the per-partition and the
+    whole-pipeline paths: classify -> NON_RETRYABLE re-raises ->
+    retryable errors recover (spill / runtime reset) and replay under a
+    fresh deadline with deterministic backoff -> exhausted attempts
+    degrade to ``fallback()`` (None = fallback unavailable: the last
+    device error re-raises).  Every DEVICE_LOST-classified error is
+    counted exactly once, when it is processed here.
+    """
+    last = error
+    attempts = attempts_used
+    while True:
+        if last is not None:
+            cls = classify_error(last)
+            if not isinstance(last, Exception) or \
+                    cls is ErrorClass.NON_RETRYABLE:
+                raise last
+            if cls is ErrorClass.DEVICE_LOST:
+                fault_metrics.record("device_lost")
+            if attempts >= policy.max_attempts:
+                out = fallback()
+                if out is None:
+                    raise last
+                fault_metrics.record("partition_fallbacks")
+                ctx.metric("task", "partitionFallbacks").add(1)
+                return out
+            _pre_replay(ctx, last, cls)
+            fault_metrics.record("retries")
+            ctx.metric("task", "retries").add(1)
+            policy.backoff(attempts)
+        try:
+            with partition_deadline(ctx.conf, label):
+                return attempt()
+        except Exception as e:  # noqa: BLE001 — classified above
+            last = e
+            attempts += 1
+
+
+def run_partition_with_retry(root, ctx, index: int,
+                             error: Optional[Exception] = None) -> List:
+    """Replay partition ``index`` of ``root`` under the unified policy.
+
+    ``error`` is the failure that already consumed attempt 1 (the
+    partition driver's first drive); None starts fresh.  Exhausted
+    device-class errors degrade to the per-partition CPU fallback.
+    """
+    return _recover_loop(
+        ctx, partition_policy(ctx.conf),
+        attempt=lambda: list(root.partitions(ctx)[index]),
+        fallback=lambda: _cpu_fallback_partition(root, ctx, index),
+        label=f"partition:{index}", error=error,
+        attempts_used=1 if error is not None else 0)
+
+
+def run_pipeline_with_recovery(op, ctx):
+    """Run the whole-pipeline collect under the recovery ladder.
+
+    The pipeline path executes an entire query stage as one program, so
+    recovery here is stage-grained: replay the stage (sources
+    re-materialize from their lineage) and, once device attempts are
+    exhausted, complete the query through the CPU plan.  Returns the
+    HostBatch, or None when the plan isn't pipeline-viable (the caller
+    then uses the iterator path, which has its own per-partition
+    recovery — a non-viable probe returns from the first ``attempt()``
+    without touching the fallback path).
+    """
+    from spark_rapids_tpu.plan.pipeline import pipeline_collect
+    return _recover_loop(
+        ctx, RetryPolicy.from_conf(ctx.conf),
+        attempt=lambda: pipeline_collect(op, ctx),
+        fallback=lambda: _cpu_fallback_collect(ctx),
+        label="pipeline")
+
+
+# -- CPU fallback -------------------------------------------------------------
+
+
+def _cpu_plan(ctx):
+    """The query's all-CPU physical plan (lowered once per ctx from the
+    logical plan session.execute attached), or None when unavailable
+    (bare ExecContext uses in unit tests)."""
+    cached = getattr(ctx, "_cpu_fallback_plan", None)
+    if cached is not None:
+        return cached
+    logical = getattr(ctx, "logical_plan", None)
+    if logical is None:
+        return None
+    from spark_rapids_tpu.plan.overrides import TpuOverrides
+    cpu_conf = ctx.conf.copy(**{"spark.rapids.sql.enabled": False})
+    try:
+        plan = TpuOverrides(cpu_conf).apply(logical)
+    except Exception:  # noqa: BLE001 — fallback must not mask the
+        return None    # original device error with a planner error
+    ctx._cpu_fallback_plan = plan
+    ctx._cpu_fallback_conf = cpu_conf
+    return plan
+
+
+def _cpu_fallback_partition(root, ctx, index: int) -> Optional[List]:
+    """Run partition ``index`` of the CPU plan; None when fallback is
+    off, no logical plan is attached, or the partition layouts of the
+    two plans cannot be aligned."""
+    if not _fallback_enabled(ctx.conf):
+        return None
+    cpu_root = _cpu_plan(ctx)
+    if cpu_root is None:
+        return None
+    from spark_rapids_tpu.plan.physical import ExecContext
+    cpu_ctx = ExecContext(ctx._cpu_fallback_conf)
+    try:
+        parts = cpu_root.partitions(cpu_ctx)
+        n_tpu = root.num_partitions(ctx)
+        if len(parts) != n_tpu:
+            if n_tpu == 1 and index == 0:
+                # single-partition plan: "that partition" IS the query
+                return [hb for p in parts for hb in p]
+            return None
+        return list(parts[index])
+    finally:
+        cpu_ctx.close_deferred()
+
+
+def _cpu_fallback_collect(ctx):
+    """Complete the whole query through the CPU plan (pipeline-path
+    degradation: the stage program spans every partition, so the
+    fallback unit is the stage)."""
+    if not _fallback_enabled(ctx.conf):
+        return None
+    cpu_root = _cpu_plan(ctx)
+    if cpu_root is None:
+        return None
+    from spark_rapids_tpu.plan.physical import ExecContext, collect_host
+    cpu_ctx = ExecContext(ctx._cpu_fallback_conf)
+    return collect_host(cpu_root, cpu_ctx)

@@ -54,7 +54,7 @@ def device_batch_bytes(batch: ColumnBatch) -> int:
 class SpillableBatch:
     """Operator-facing handle for a batch that may move between tiers."""
 
-    TIER_DEVICE, TIER_HOST, TIER_DISK = 0, 1, 2
+    TIER_DEVICE, TIER_HOST, TIER_DISK, TIER_LOST = 0, 1, 2, 3
 
     def __init__(self, catalog: "BufferCatalog", batch_id: int,
                  device_batch: ColumnBatch, priority: int):
@@ -74,6 +74,8 @@ class SpillableBatch:
 
     def _spill_to_host(self):
         assert self.tier == self.TIER_DEVICE
+        from spark_rapids_tpu.fault import inject
+        inject.maybe_fire("spill")
         self._host = device_to_host(self._device)
         self._device = None
         self.tier = self.TIER_HOST
@@ -127,6 +129,12 @@ class SpillableBatch:
     def get(self) -> ColumnBatch:
         """Materialize on device (unspilling if needed)."""
         assert not self.closed
+        if self.tier == self.TIER_LOST:
+            from spark_rapids_tpu.fault.errors import DeviceLostError
+            raise DeviceLostError(
+                f"spillable batch {self.batch_id} was device-resident "
+                "when the device was lost and no host/disk copy "
+                "survived; its lineage must be recomputed")
         if self.tier == self.TIER_DEVICE:
             return self._device
         if self.tier == self.TIER_DISK:
@@ -265,6 +273,44 @@ class BufferCatalog:
                     self.metrics.get("oom_spill_bytes", 0) + freed
         return freed
 
+    def invalidate_device_tier(self, rescue: bool = True) -> int:
+        """Device-lost recovery (fault.recovery): every device-tier
+        handle is rescued to host when the buffers still answer (the
+        simulated-fault case — and real losses where XLA kept the copy
+        readable), else marked TIER_LOST so a later ``get()`` raises a
+        classified DeviceLostError and the consumer's replay recomputes
+        the batch from lineage.  ``rescue=False`` (timeout-classified
+        recovery: the device is WEDGED, a rescue D2H against it would
+        block the recovery path on the very hang being recovered from)
+        marks device-tier handles lost without touching the device.
+        Host- and disk-tier handles are untouched: they re-upload
+        lazily on the next ``get()``.  Returns the number of handles
+        that transitioned.
+        """
+        moved = 0
+        with self._lock:
+            for h in list(self._handles.values()):
+                if h.closed or h.tier != SpillableBatch.TIER_DEVICE:
+                    continue
+                moved += 1
+                if rescue:
+                    try:
+                        h._spill_to_host()
+                        self.metrics["spilled_to_host"] += 1
+                        continue
+                    except Exception:  # noqa: BLE001 — buffers truly gone
+                        pass
+                h._device = None
+                h._host = None
+                h.tier = SpillableBatch.TIER_LOST
+                self.metrics["lost_batches"] = \
+                    self.metrics.get("lost_batches", 0) + 1
+            if moved:
+                self.metrics["device_invalidated"] = \
+                    self.metrics.get("device_invalidated", 0) + moved
+                self._enforce_host_budget()
+        return moved
+
     def _pick_victim(self, tier: int, exclude: int
                      ) -> Optional[SpillableBatch]:
         best = None
@@ -286,26 +332,44 @@ def is_device_oom(err: BaseException) -> bool:
         and type(err).__name__ in ("XlaRuntimeError", "JaxRuntimeError")
 
 
-def run_with_oom_retry(catalog: "BufferCatalog", thunk, retries: int = 2,
+def run_with_oom_retry(catalog: "BufferCatalog", thunk,
+                       retries: Optional[int] = None,
                        pinned=(), on_retry=None):
     """Run ``thunk`` and, on a device OOM, spill everything spillable and
     re-run — the engine-side analogue of the reference's alloc-failure →
     synchronous-spill → retry loop (DeviceMemoryEventHandler.scala:35,
-    RmmRapidsRetryIterator.scala's withRetry).  Gives up when a retry frees
-    nothing (spilling can no longer help) or ``retries`` is exhausted.
-    ``pinned``: batches the thunk re-reads on retry (see
+    RmmRapidsRetryIterator.scala's withRetry).
+
+    Thin wrapper over the unified fault machinery: the error must
+    classify RETRYABLE_OOM (fault.errors — covers real XLA
+    RESOURCE_EXHAUSTED and injected OOMs alike) and the attempt bound
+    comes from the one RetryPolicy
+    (``spark.rapids.sql.tpu.retry.maxAttempts``) unless ``retries``
+    overrides it (``retries=0`` = fail fast, the donated-dispatch
+    path).  No backoff sleep here: the corrective action (the spill)
+    already completed synchronously, so there is no transient condition
+    to wait out — backoff belongs to the device-lost replay ladder.
+    Still gives up early when a retry frees nothing — spilling can no
+    longer help.  ``pinned``: batches the thunk re-reads on retry (see
     :meth:`BufferCatalog.handle_alloc_failure`).
     """
+    from spark_rapids_tpu.fault import metrics as fault_metrics
+    from spark_rapids_tpu.fault.errors import ErrorClass, classify_error
+    from spark_rapids_tpu.fault.retry import RetryPolicy
+    max_attempts = RetryPolicy.from_conf(catalog.conf).max_attempts \
+        if retries is None else retries + 1
     attempt = 0
     while True:
+        attempt += 1
         try:
             return thunk()
-        except Exception as e:  # noqa: BLE001 - filtered by is_device_oom
-            if not is_device_oom(e) or attempt >= retries:
+        except Exception as e:  # noqa: BLE001 — filtered by classification
+            if classify_error(e) is not ErrorClass.RETRYABLE_OOM or \
+                    attempt >= max_attempts:
                 raise
             freed = catalog.handle_alloc_failure(pinned=pinned)
             if freed == 0:
                 raise
             if on_retry is not None:
                 on_retry(freed)
-            attempt += 1
+            fault_metrics.record("retries")

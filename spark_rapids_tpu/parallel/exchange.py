@@ -257,6 +257,8 @@ class TpuShuffleExchangeExec(TpuExec):
             [iter([]) for _ in range(n)]
 
     def partitions(self, ctx):
+        from spark_rapids_tpu.fault import inject
+        inject.maybe_fire("exchange")
         if self._mesh_active(ctx):
             return self._mesh_partitions(ctx)
         n = self.partitioning.num_partitions
@@ -307,9 +309,14 @@ class TpuShuffleExchangeExec(TpuExec):
         # ends (ctx.close_deferred).  The cache holds the ctx via weakref:
         # exec nodes live as long as the session's plan cache, and a strong
         # ref would pin a finished query's whole object graph.
+        # Generation-checked (fault.recovery): a device-lost reset bumps
+        # the runtime generation, so a partition REPLAY recomputes the
+        # split from lineage instead of draining pieces whose device
+        # copies died with the old device.
         import weakref
+        gen = DeviceRuntime.generation()
         cached = getattr(self, "_split_cache", None)
-        if cached is not None and cached[0]() is ctx:
+        if cached is not None and cached[0]() is ctx and cached[2] == gen:
             return [self._drain_cached(p) for p in cached[1]]
         catalog = DeviceRuntime.get(ctx.conf).catalog
         from spark_rapids_tpu.batch import (
@@ -369,7 +376,7 @@ class TpuShuffleExchangeExec(TpuExec):
         ctx.metric(self.op_id, "shuffleRows").add(sum(self._last_part_rows))
         ctx.metric(self.op_id, "shuffleWallNs").add(
             _time.monotonic_ns() - t0)
-        self._split_cache = (weakref.ref(ctx), out)
+        self._split_cache = (weakref.ref(ctx), out, gen)
         return [self._drain_cached(p) for p in out]
 
     @staticmethod

@@ -136,7 +136,16 @@ class _ReadAheadChannel:
     ``put`` returns False once stopped (the consumer has left: the item is
     dropped, never stranded).  ``get`` returns the sentinel ``None`` when
     stopped-and-drained.
+
+    Waits are BOUNDED (re-armed in a loop): ``notify`` still wakes them
+    immediately — the bound never adds latency — but it caps how long
+    the blocked thread sits inside one C-level wait, so an async
+    exception (the fault watchdog's PartitionTimeout, delivered only
+    between Python bytecodes) reaches a consumer parked here within the
+    bound instead of after the producer's entire stall.
     """
+
+    _WAIT_SLICE = 0.25
 
     def __init__(self, depth: int):
         self._items = collections.deque()
@@ -151,7 +160,7 @@ class _ReadAheadChannel:
     def put(self, item) -> bool:
         with self._cond:
             while not self._stopped and len(self._items) >= self._depth:
-                self._cond.wait()
+                self._cond.wait(self._WAIT_SLICE)
             if self._stopped:
                 return False
             self._items.append(item)
@@ -161,7 +170,7 @@ class _ReadAheadChannel:
     def get(self):
         with self._cond:
             while not self._stopped and not self._items:
-                self._cond.wait()
+                self._cond.wait(self._WAIT_SLICE)
             if self._items:
                 item = self._items.popleft()
                 self._cond.notify_all()
@@ -193,11 +202,21 @@ class HostToDeviceExec(TpuExec):
         t_metric = ctx.metric(self.op_id, "stageTime")
         depth = STAGE_READAHEAD_BATCHES.get(ctx.conf)
 
+        def acquire_counted():
+            # pipeline_collect counts H2D-side acquires via
+            # ctx._pipeline_h2d and releases that many in its finally —
+            # counting AT ACQUIRE TIME (not per materialized source)
+            # keeps the books right when an abort (PartitionTimeout,
+            # device loss) lands mid-source
+            if ctx.semaphore is not None:
+                ctx.semaphore.acquire()
+                if hasattr(ctx, "_pipeline_h2d"):
+                    ctx._pipeline_h2d += 1
+
         def stage(hb, catalog):
             from spark_rapids_tpu.mem.catalog import run_with_oom_retry
             t0 = time.monotonic()
-            if ctx.semaphore is not None:
-                ctx.semaphore.acquire()
+            acquire_counted()
             db = run_with_oom_retry(
                 catalog, lambda: host_to_device(hb, device=ctx.device))
             t_metric.add(time.monotonic() - t0)
@@ -263,8 +282,7 @@ class HostToDeviceExec(TpuExec):
                     # device admission on the CONSUMER (main) thread —
                     # re-entrant there, and paired with DeviceToHostExec's
                     # release on the same thread
-                    if ctx.semaphore is not None:
-                        ctx.semaphore.acquire()
+                    acquire_counted()
                     yield v
             finally:
                 # Wake + reap the worker (bounded): stop() drains the
@@ -308,29 +326,29 @@ class DeviceToHostExec(CpuExec):
 
 
 def run_partition_with_retry(root: PhysicalOp, ctx: ExecContext,
-                             index: int) -> List:
+                             index: int, error=None) -> List:
     """Materialize one partition with retries (Spark task-retry analogue —
     SURVEY.md section 5: failure detection is delegated to task retry +
     lineage; partitions are pure recomputations of their lineage here too).
+
+    Thin wrapper: the loop itself lives in fault.recovery, which
+    classifies the failure (fault.errors), applies the unified
+    RetryPolicy (spill on OOM, runtime reset + device-tier invalidation
+    on device loss) and, once device attempts are exhausted, completes
+    just this partition through the CPU operator path
+    (``spark.rapids.sql.tpu.fallback.onDeviceError``).  ``error`` is the
+    failure that already consumed attempt 1.
     """
-    max_failures = int(ctx.conf.get("spark.rapids.task.maxFailures", 2))
-    last_err = None
-    for attempt in range(max(1, max_failures)):
-        try:
-            return list(root.partitions(ctx)[index])
-        except MemoryError:
-            raise
-        except Exception as e:  # noqa: BLE001 — retried, then re-raised
-            last_err = e
-            ctx.metric("task", "retries").add(1)
-    raise last_err
+    from spark_rapids_tpu.fault import recovery
+    return recovery.run_partition_with_retry(root, ctx, index, error=error)
 
 
 def _drive_partitions(root: PhysicalOp, ctx: ExecContext,
                       release_partial: bool) -> List:
     """Drive every partition of ``root`` (trace range, MemoryError
-    pass-through, per-partition retry, collect/batches metric) into one
-    flat batch list — shared by the bulk and iterator collect paths.
+    pass-through, per-partition deadline + retry, collect/batches
+    metric) into one flat batch list — shared by the bulk and iterator
+    collect paths.
 
     ``release_partial=True`` (bulk path, where the semaphore release for
     a batch happens only after the final D2H): a partition attempt that
@@ -340,12 +358,19 @@ def _drive_partitions(root: PhysicalOp, ctx: ExecContext,
     per converted batch (DeviceToHostExec), so it must NOT double-release
     here.
     """
+    from spark_rapids_tpu.fault.watchdog import partition_deadline
     from spark_rapids_tpu.utils.tracing import trace_range
+    with partition_deadline(ctx.conf, "plan-partitions"):
+        # eager per-op work (e.g. the exchange split) happens here, under
+        # its own deadline — a wedge before the first partition must
+        # trip the watchdog too
+        parts = root.partitions(ctx)
     flat: List = []
-    for i, part in enumerate(root.partitions(ctx)):
+    for i, part in enumerate(parts):
         got: List = []
         try:
-            with trace_range(f"partition:{i}"):
+            with trace_range(f"partition:{i}"), \
+                    partition_deadline(ctx.conf, f"partition:{i}"):
                 for b in part:
                     got.append(b)
         except BaseException as e:
@@ -358,7 +383,7 @@ def _drive_partitions(root: PhysicalOp, ctx: ExecContext,
                 # KeyboardInterrupt/SystemExit must never be swallowed
                 # by a successful retry
                 raise
-            got = run_partition_with_retry(root, ctx, i)
+            got = run_partition_with_retry(root, ctx, i, error=e)
         flat.extend(got)
         ctx.metric("collect", "batches").add(len(got))
     return flat
@@ -379,17 +404,30 @@ def _collect_device_bulk(root: PhysicalOp, ctx: ExecContext
     try:
         if not flat:
             return []
-        sizes = host_sizes(flat)
-        shrunk = [shrink_to_fit(b, sizes=s) for b, s in zip(flat, sizes)]
-        return [hb for hb in device_to_host_many(shrunk) if hb.num_rows]
+        # A partition completed via the CPU fallback path yields
+        # HostBatch directly: pass those through in place and run the
+        # sizes-sync + bulk D2H over the device batches only.
+        out: List = list(flat)
+        dev = [(j, b) for j, b in enumerate(flat)
+               if isinstance(b, ColumnBatch)]
+        if dev:
+            dbs = [b for _, b in dev]
+            sizes = host_sizes(dbs)
+            shrunk = [shrink_to_fit(b, sizes=s)
+                      for b, s in zip(dbs, sizes)]
+            for (j, _), hb in zip(dev, device_to_host_many(shrunk)):
+                out[j] = hb
+        return [hb for hb in out if hb.num_rows]
     finally:
         # results left the device (or the sizes/D2H step failed — either
         # way this collect is done with them): release once per collected
-        # batch, pairing with the H2D-side acquires (DeviceToHostExec's
-        # role in the iterator path)
+        # DEVICE batch, pairing with the H2D-side acquires
+        # (DeviceToHostExec's role in the iterator path); CPU-fallback
+        # host batches never took device admission
         if ctx.semaphore is not None:
-            for _ in flat:
-                ctx.semaphore.release()
+            for b in flat:
+                if isinstance(b, ColumnBatch):
+                    ctx.semaphore.release()
 
 
 def _async_collect_enabled(ctx: ExecContext) -> bool:
@@ -402,10 +440,12 @@ def collect_host(op: PhysicalOp, ctx: ExecContext) -> HostBatch:
     from spark_rapids_tpu.utils.tracing import trace_range
     try:
         if op.is_tpu:
-            from spark_rapids_tpu.plan.pipeline import pipeline_collect
+            from spark_rapids_tpu.fault.recovery import (
+                run_pipeline_with_recovery,
+            )
             with trace_range("pipeline_collect",
                              ctx.metric("collect", "wallTimeNs")):
-                hb = pipeline_collect(op, ctx)
+                hb = run_pipeline_with_recovery(op, ctx)
             if hb is not None:
                 return hb
             if _async_collect_enabled(ctx):

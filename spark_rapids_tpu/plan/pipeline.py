@@ -275,10 +275,10 @@ def _materialize_sources(sources: List[PhysicalOp], ctx: ExecContext,
             batches = []
             for part in src.partitions(ctx):
                 batches.extend(part)
+            # H2D-side semaphore acquires are counted into
+            # ctx._pipeline_h2d at acquire time (HostToDeviceExec), so
+            # an abort mid-source releases exactly what was taken
             donatable = isinstance(src, HostToDeviceExec)
-            if donatable:
-                ctx._pipeline_h2d = \
-                    getattr(ctx, "_pipeline_h2d", 0) + len(batches)
             mats.append([batches, None, donatable])
     if pending:
         # one sizes round trip across EVERY stage-break source, taken
@@ -365,14 +365,32 @@ def _run_oom_guarded(ctx: ExecContext, thunk, args=(), retryable=True):
     the stage's input batches, still referenced by the retry — are pinned
     so the spill pass doesn't waste a pass "freeing" live buffers.
     ``retryable=False`` (donated inputs: consumed at dispatch, a retry
-    cannot re-present them) fails fast with the original OOM instead."""
+    cannot re-present them) fails fast with the original OOM, TAGGED
+    NON_RETRYABLE (fault.errors taxonomy: donated-dispatch OOM) so no
+    outer recovery level replays against consumed buffers either."""
+    from spark_rapids_tpu.fault.errors import (
+        ErrorClass, classify_error, mark_non_retryable,
+    )
     from spark_rapids_tpu.mem.catalog import run_with_oom_retry
     from spark_rapids_tpu.runtime.device import DeviceRuntime
     pinned = [b for bs in args for b in bs]
-    return run_with_oom_retry(
-        DeviceRuntime.get(ctx.conf).catalog, thunk,
-        retries=2 if retryable else 0, pinned=pinned,
-        on_retry=lambda _freed: ctx.metric("pipeline", "oom_retries").add(1))
+    try:
+        return run_with_oom_retry(
+            DeviceRuntime.get(ctx.conf).catalog, thunk,
+            retries=None if retryable else 0, pinned=pinned,
+            on_retry=lambda _freed: ctx.metric("pipeline",
+                                               "oom_retries").add(1))
+    except Exception as e:
+        # only raw XLA OOMs get the donated tag: they come from the
+        # dispatch itself, after the inputs were consumed.  An error
+        # already carrying an explicit class (an injected fault fires at
+        # the call site, BEFORE any buffer is consumed) keeps it — the
+        # stage replay is sound there.
+        if not retryable and \
+                getattr(e, "rapids_error_class", None) is None and \
+                classify_error(e) is ErrorClass.RETRYABLE_OOM:
+            raise mark_non_retryable(e)
+        raise
 
 
 def _run_stage(root: PhysicalOp, ctx: ExecContext,

@@ -53,7 +53,11 @@ class TpuSemaphore:
                     self._available -= 1
                     self._depth = 1
                     return
-                self._cond.wait()
+                # bounded wait: release/notify still wakes immediately;
+                # the bound only caps the C-level block so the fault
+                # watchdog's async PartitionTimeout can be delivered to
+                # a thread parked on device admission
+                self._cond.wait(0.25)
 
     def release(self):
         with self._cond:
@@ -82,6 +86,11 @@ class DeviceRuntime:
 
     _instance: Optional["DeviceRuntime"] = None
     _lock = threading.Lock()
+    # Bumped by every recover(): state derived from device buffers
+    # (exchange split caches) records the generation it was built under
+    # and treats a mismatch as invalid — a replay after a device loss
+    # then recomputes from lineage instead of reading lost pieces.
+    _generation = 0
 
     def __init__(self, conf: RapidsConf):
         self.conf = conf
@@ -104,3 +113,32 @@ class DeviceRuntime:
     def reset(cls):
         with cls._lock:
             cls._instance = None
+
+    @classmethod
+    def generation(cls) -> int:
+        with cls._lock:
+            return cls._generation
+
+    @classmethod
+    def recover(cls, conf: RapidsConf, rescue: bool = True
+                ) -> "DeviceRuntime":
+        """Device-lost recovery: rebuild the runtime (fresh device pick +
+        fresh semaphore — a permit wedged by the dead attempt cannot
+        block the replay) while KEEPING the spill catalog so host/disk
+        copies survive; its device tier is invalidated (best-effort
+        rescue to host when ``rescue``, else marked lost — mem.catalog).
+
+        The invalidation runs OUTSIDE the class lock: a rescue D2H
+        against a sick device can block, and holding ``_lock`` through
+        it would wedge every thread touching ``get()``/``generation()``
+        — the hang this subsystem exists to prevent."""
+        with cls._lock:
+            old = cls._instance
+            cls._generation += 1
+            inst = DeviceRuntime(conf)
+            if old is not None:
+                inst.catalog = old.catalog
+            cls._instance = inst
+        if old is not None:
+            old.catalog.invalidate_device_tier(rescue=rescue)
+        return inst

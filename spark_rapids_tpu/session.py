@@ -147,20 +147,47 @@ class TpuSparkSession:
         return self._mesh
 
     def execute(self, plan) -> HostBatch:
+        from spark_rapids_tpu.config import FAULTS_SPEC
+        from spark_rapids_tpu.fault import inject as fault_inject
+        from spark_rapids_tpu.fault import metrics as FM
         from spark_rapids_tpu.plan.physical import ExecContext, collect_host
         from spark_rapids_tpu.utils import compile_registry as CR
         phys = self.plan_physical(plan)
         if self.conf.test_enforce_tpu:
             _assert_on_tpu(phys)
+        if self.runtime is not None:
+            # re-resolve: a device-lost recovery mid-query rebuilds the
+            # process runtime (new semaphore/device, same catalog) — the
+            # next query must ride the live instance, not the dead one
+            from spark_rapids_tpu.runtime.device import DeviceRuntime
+            self.runtime = DeviceRuntime.get(self.conf)
         ctx = ExecContext(
             self.conf,
             semaphore=self.runtime.semaphore if self.runtime else None,
             device=self.runtime.device if self.runtime else None,
             mesh=self._shuffle_mesh())
+        # the fault-recovery CPU fallback re-lowers THIS logical plan
+        # with sql.enabled=false to replay a failed partition on the CPU
+        # operator path (fault.recovery)
+        ctx.logical_plan = plan
+        # (re)install the deterministic fault registry per query: call
+        # counters reset so "the Nth dispatch" is query-relative; an
+        # empty spec clears any previously installed registry, and the
+        # finally clears an armed one so persistent @N+ rules cannot
+        # outlive the query and fire at sites with no recovery around
+        # them (e.g. ml.to_device_batches staging outside execute)
+        spec = FAULTS_SPEC.get(self.conf)
+        fault_inject.install(spec)
         self.last_physical_plan = phys
         self.last_exec_ctx = ctx
         before = CR.snapshot()
-        out = collect_host(phys, ctx)
+        fm_before = FM.snapshot()
+        try:
+            out = collect_host(phys, ctx)
+        finally:
+            if spec:
+                fault_inject.uninstall()
+        fm_d = FM.delta(fm_before, FM.snapshot())
         d = CR.delta(before, CR.snapshot())
         self.last_metrics = {
             op: {name: m.value for name, m in ms.items()}
@@ -183,6 +210,15 @@ class TpuSparkSession:
         self.last_metrics["deviceTimeNs"] = sum(
             ms["deviceTimeNs"].value for ms in ctx.metrics.values()
             if "deviceTimeNs" in ms)
+        # fault-tolerance economics (fault.metrics deltas): recovery
+        # replays, deterministic-backoff wall, device losses handled,
+        # partitions completed via the CPU path, and injected faults
+        self.last_metrics["retryCount"] = fm_d["retries"]
+        self.last_metrics["backoffWallNs"] = fm_d["backoff_wall_ns"]
+        self.last_metrics["deviceLostCount"] = fm_d["device_lost"]
+        self.last_metrics["partitionFallbackCount"] = \
+            fm_d["partition_fallbacks"]
+        self.last_metrics["faultsInjected"] = fm_d["faults_injected"]
         if self.runtime is not None:
             self.last_metrics["memory"] = dict(self.runtime.catalog.metrics)
         return out

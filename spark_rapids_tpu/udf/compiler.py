@@ -34,6 +34,13 @@ _BINOPS = {
     "+": "Add", "-": "Subtract", "*": "Multiply", "/": "Divide",
     "%": "Remainder", "**": "Pow",
 }
+# Python <= 3.10 emits one opcode per operator instead of 3.11's single
+# parameterized BINARY_OP; both spellings compile to the same engine
+# expressions.
+_BINOP_OPCODES = {
+    "BINARY_ADD": "+", "BINARY_SUBTRACT": "-", "BINARY_MULTIPLY": "*",
+    "BINARY_TRUE_DIVIDE": "/", "BINARY_MODULO": "%", "BINARY_POWER": "**",
+}
 _CMPOPS = {
     "==": "Equals", "!=": "NotEquals", "<": "LessThan",
     "<=": "LessThanOrEqual", ">": "GreaterThan", ">=": "GreaterThanOrEqual",
@@ -170,9 +177,9 @@ class _Compiler:
                     raise CannotCompile(f"attr on {obj!r}")
                 i += 1
                 continue
-            if op == "BINARY_OP":
+            if op == "BINARY_OP" or op in _BINOP_OPCODES:
                 b, a = stack.pop(), stack.pop()
-                sym = ins.argrepr.strip().rstrip("=")
+                sym = _BINOP_OPCODES.get(op) or ins.argrepr.strip().rstrip("=")
                 if sym not in _BINOPS:
                     raise CannotCompile(f"binop {ins.argrepr}")
                 stack.append(_binop(sym, _as_expr(a), _as_expr(b)))
@@ -246,7 +253,11 @@ class _Compiler:
                 return _as_expr(stack.pop())
             if op == "RETURN_CONST":
                 return Literal(ins.argval)
-            raise CannotCompile(f"opcode {op}")
+            import sys
+            pyver = ".".join(map(str, sys.version_info[:2]))
+            raise CannotCompile(
+                f"unsupported opcode {op} (python {pyver}); the UDF "
+                "falls back to row-at-a-time CPU execution")
         raise CannotCompile("fell off end of bytecode")
 
     def _call_method(self, m: _Method, args) -> Expression:

@@ -48,6 +48,8 @@ from typing import Any, Callable, Dict, Optional
 
 import jax
 
+from spark_rapids_tpu.fault import inject as _fault_inject
+
 _LOCK = threading.Lock()
 _STATS: Dict[str, int] = {
     # cumulative process-wide; per-query deltas come from snapshot() pairs
@@ -296,6 +298,9 @@ def instrumented_jit(fn: Optional[Callable] = None, *, label: str = "",
             # dispatch nor a separate compile — don't count it (donation
             # of a traced value is likewise meaningless and ignored)
             return jitted(*args, **kwargs)
+        # fault-injection site: every real dispatch (not nested traces)
+        # counts; disarmed cost is one module-global None test
+        _fault_inject.maybe_fire("dispatch")
         if _DONATION_GUARD is not None:
             guard_check((args, kwargs), name)
         donated_bytes = 0

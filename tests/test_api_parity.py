@@ -64,6 +64,16 @@ EXEC_PARITY = {
     "ColumnarRdd": ("spark_rapids_tpu.ml", "to_device_batches"),
     "UCXShuffleTransport": ("spark_rapids_tpu.parallel.mesh_shuffle",
                             "make_exchange_fn"),
+    # fault tolerance: the reference's retry/OOM machinery
+    # (RmmRapidsRetryIterator's withRetry + RetryOOM taxonomy) and the
+    # task-retry delegation (SURVEY.md section 5) map to the unified
+    # fault subsystem
+    "RmmRapidsRetryIterator": ("spark_rapids_tpu.fault.retry",
+                               "RetryPolicy"),
+    "DeviceMemoryEventHandler": ("spark_rapids_tpu.mem.catalog",
+                                 "run_with_oom_retry"),
+    "TaskRetryLineage": ("spark_rapids_tpu.fault.recovery",
+                         "run_partition_with_retry"),
 }
 
 # reference expression file (SURVEY.md 2.5 expression library) -> our module
