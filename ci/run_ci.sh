@@ -25,6 +25,18 @@ export PYTEST_PER_TEST_TIMEOUT="${PYTEST_PER_TEST_TIMEOUT:-120}"
 echo "== docs/configs.md freshness"
 python ci/gen_configs_doc.py --check
 
+# Static analysis gate BEFORE any test runs: rapidslint is runtime-free
+# (plain ast, no jax import) so the whole tree checks in ~2s — a lint
+# regression fails the build without paying for a suite run first.
+# Budget: must stay under 15s.  See docs/static_analysis.md.
+echo "== rapidslint gate"
+python tools/rapidslint.py --check
+
+# Structural plan verification for every query the suite executes:
+# schema/transition consistency, donation-mask provenance, semaphore
+# balance (spark_rapids_tpu/analysis/plan_verify.py via tests/conftest.py).
+export RAPIDS_PLAN_VERIFY=1
+
 if [ "$MODE" = "quick" ]; then
   python -m pytest tests/test_kernels_layout.py tests/test_kernels_join.py \
       tests/test_exprs.py tests/test_e2e_basic.py -q

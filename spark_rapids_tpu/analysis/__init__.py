@@ -1,0 +1,33 @@
+"""Static analysis: project-specific lints + plan-invariant verification.
+
+The reference spark-rapids design treats detection machinery as co-equal
+with the kernels: unsupported or unsafe constructs are *tagged and
+flagged*, never silently executed (SURVEY.md §1, the GpuOverrides
+tagging/fallback model).  This package applies the same philosophy to the
+engine's own source: every recurring bug class that past PRs burned
+debugging time on — tracer-leaking module constants, orphaned semaphore
+permits, watchdog-defeating unbounded waits, swallowed
+KeyboardInterrupt, unaccounted donation, config/metrics drift — is
+mechanically detectable from the AST, so ``rapidslint`` makes the class
+extinct instead of re-fixed.
+
+Layout:
+
+* :mod:`~spark_rapids_tpu.analysis.engine` — rule framework: file
+  loading, per-line ``# rapidslint: disable=<id>`` suppressions, the
+  checked-in baseline (``tools/rapidslint_baseline.json``), finding
+  fingerprints that survive line drift.
+* :mod:`~spark_rapids_tpu.analysis.rules` — the project rule catalog
+  (R1..R8), each distilled from a real incident (docs/static_analysis.md
+  maps rule -> incident).
+* :mod:`~spark_rapids_tpu.analysis.plan_verify` — runtime plan-invariant
+  verifier: schema consistency across operator boundaries,
+  donation-mask provenance, semaphore/catalog balance.  Wired into every
+  tier-1 query via tests/conftest.py behind ``RAPIDS_PLAN_VERIFY=1``.
+
+Entry point: ``tools/rapidslint.py --check`` (the CI lint gate).
+"""
+
+from .engine import (  # noqa: F401
+    Finding, LintEngine, Rule, Severity,
+)

@@ -1,0 +1,183 @@
+"""Runtime-free plan-invariant verifier.
+
+Checks structural invariants of an already-built physical plan — no
+dispatch, no device work, no re-execution.  Three families:
+
+* **Schema consistency** — every operator's output schema is well formed
+  (unique names, concrete dtypes) and the planner-inserted transitions
+  (`HostToDeviceExec` / `DeviceToHostExec`) are schema-transparent; any
+  CPU<->TPU flip in the tree happens ONLY through those transitions
+  (GpuTransitionOverrides invariant).
+* **Donation-mask provenance** — every cached stage program
+  (``op._stage_cache``, plan/pipeline.py) may donate a source's buffers
+  only when that source is a stage-break intermediate or a fresh
+  `HostToDeviceExec` staging.  Cached scans, spill-catalog handles and
+  broadcast builds are re-referenced across partitions/queries: donating
+  one hands live HBM to XLA and the next read returns garbage (or a
+  deleted-buffer error on backends that check).
+* **Semaphore balance** — after a query completes, the task-wide
+  re-entrant hold depth must be back to zero; a leaked permit silently
+  halves device admission for every later query in the process.
+
+The module imports no engine code at import time so `tools/rapidslint.py`
+and other host-only tooling can load it without pulling in jax; the
+isinstance probes import lazily inside the checks.
+
+Used by ``tests/conftest.py`` behind ``RAPIDS_PLAN_VERIFY=1`` (on in CI)
+to verify every plan the suite executes, and directly by
+``tests/test_lint.py`` fixtures.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+
+class PlanInvariantError(AssertionError):
+    """A physical plan violated a structural invariant."""
+
+    def __init__(self, problems: List[str]):
+        self.problems = list(problems)
+        super().__init__(
+            "plan invariant violation(s):\n  - " + "\n  - ".join(problems))
+
+
+def _walk(op) -> Iterator:
+    seen = set()
+    stack = [op]
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:   # joins may share a cached build subtree
+            continue
+        seen.add(id(node))
+        yield node
+        stack.extend(getattr(node, "children", ()) or ())
+
+
+def _describe(op) -> str:
+    return f"{type(op).__name__}[{getattr(op, 'op_id', '?')}]"
+
+
+def check_schemas(root) -> List[str]:
+    """Well-formed output schemas + schema-transparent transitions."""
+    from spark_rapids_tpu.plan.physical import (
+        DeviceToHostExec, HostToDeviceExec,
+    )
+    problems = []
+    for op in _walk(root):
+        schema = getattr(op, "output_schema", None)
+        fields = getattr(schema, "fields", None)
+        if fields is None:
+            problems.append(f"{_describe(op)}: missing output schema")
+            continue
+        names = [f.name for f in fields]
+        if len(set(names)) != len(names):
+            problems.append(
+                f"{_describe(op)}: duplicate output columns {names}")
+        for f in fields:
+            if f.dtype is None:
+                problems.append(
+                    f"{_describe(op)}: column {f.name!r} has no dtype")
+        if isinstance(op, (HostToDeviceExec, DeviceToHostExec)):
+            child = op.children[0]
+            cs = child.output_schema
+            if [(f.name, f.dtype) for f in cs.fields] != \
+                    [(f.name, f.dtype) for f in fields]:
+                problems.append(
+                    f"{_describe(op)}: transition altered schema "
+                    f"{cs.fields} -> {fields}")
+    return problems
+
+
+def check_boundaries(root) -> List[str]:
+    """CPU<->TPU flips only through the planner's transition nodes."""
+    from spark_rapids_tpu.plan.physical import (
+        DeviceToHostExec, HostToDeviceExec,
+    )
+    problems = []
+    for op in _walk(root):
+        if isinstance(op, (HostToDeviceExec, DeviceToHostExec)):
+            continue  # the sanctioned flips
+        for child in getattr(op, "children", ()) or ():
+            if bool(getattr(op, "is_tpu", False)) != \
+                    bool(getattr(child, "is_tpu", False)):
+                problems.append(
+                    f"{_describe(op)} (is_tpu={op.is_tpu}) feeds from "
+                    f"{_describe(child)} (is_tpu={child.is_tpu}) without "
+                    "a HostToDevice/DeviceToHost transition")
+    return problems
+
+
+def check_donation_provenance(root) -> List[str]:
+    """Every True bit in a cached stage's donation mask must point at a
+    stage-break intermediate or a HostToDeviceExec staging — the only
+    sources whose batches the stage provably consumes exactly once
+    (plan/pipeline.py ``_materialize_sources`` contract)."""
+    from spark_rapids_tpu.plan.physical import HostToDeviceExec
+    problems = []
+    for op in _walk(root):
+        cache = getattr(op, "_stage_cache", None)
+        builds = getattr(op, "_stage_builds", None)
+        if not isinstance(cache, dict) or not isinstance(builds, dict):
+            continue
+        for key in cache:
+            variant, _spec, dmask = key
+            if variant not in builds:
+                problems.append(
+                    f"{_describe(op)}: stage program cached for variant "
+                    f"{variant!r} with no recorded build")
+                continue
+            sources = builds[variant][0]
+            if len(dmask) != len(sources):
+                problems.append(
+                    f"{_describe(op)}: donation mask arity {len(dmask)} != "
+                    f"{len(sources)} sources (variant {variant!r})")
+                continue
+            for i, donated in enumerate(dmask):
+                if not donated:
+                    continue
+                src = sources[i]
+                if isinstance(src, HostToDeviceExec):
+                    continue
+                if getattr(src, "pipeline_stage_break", False):
+                    continue
+                problems.append(
+                    f"{_describe(op)}: variant {variant!r} donates source "
+                    f"{i} ({_describe(src)}), which is neither a "
+                    "stage-break intermediate nor a HostToDevice staging")
+    return problems
+
+
+def check_semaphore_balance(runtime) -> List[str]:
+    """Post-query the task-wide hold depth must be zero."""
+    sem = getattr(runtime, "semaphore", None)
+    if sem is None:
+        return []
+    depth = sem.held_depth()
+    if depth != 0:
+        return [f"semaphore hold depth {depth} != 0 after query "
+                "completion (leaked device admission permit)"]
+    return []
+
+
+def verify_plan(root, runtime=None) -> None:
+    """Run every check; raise :class:`PlanInvariantError` on violations."""
+    problems = []
+    problems += check_schemas(root)
+    problems += check_boundaries(root)
+    problems += check_donation_provenance(root)
+    if runtime is not None:
+        problems += check_semaphore_balance(runtime)
+    if problems:
+        raise PlanInvariantError(problems)
+
+
+def verify_session(session) -> None:
+    """Verify the most recent query a :class:`TpuSparkSession` executed.
+
+    Convenience entry point for the conftest hook: pulls the plan and
+    runtime off the session, no-op when no query ran yet."""
+    root = getattr(session, "last_physical_plan", None)
+    if root is None:
+        return
+    verify_plan(root, runtime=getattr(session, "runtime", None))

@@ -265,7 +265,9 @@ STAGE_READAHEAD_BATCHES = conf_int(
     "pattern, GpuParquetScan.scala:647-700).  0 = synchronous staging.")
 PARQUET_ENABLED = conf_bool(
     "spark.rapids.sql.format.parquet.enabled", True,
-    "Enable TPU-accelerated parquet scans.")
+    "Enable the accelerated parquet scan path: multi-threaded read-ahead "
+    "decode plus row-group predicate pushdown.  Disabled falls back to "
+    "single-threaded plain decode.")
 SCAN_PUSHDOWN_ENABLED = conf_bool(
     "spark.rapids.sql.scan.pushdown.enabled", True,
     "Push filter conjuncts into file scans: parquet row groups are "
@@ -320,7 +322,8 @@ NLJ_PAIR_CAPACITY = conf_int(
     "(the reference streams broadcast NLJ per stream batch).")
 CSV_ENABLED = conf_bool(
     "spark.rapids.sql.format.csv.enabled", True,
-    "Enable TPU-accelerated CSV scans.")
+    "Enable the accelerated CSV scan path (multi-threaded read-ahead "
+    "decode).  Disabled falls back to single-threaded decode.")
 COALESCE_TARGET_ROWS = conf_int(
     "spark.rapids.sql.coalesce.targetRows", 1 << 20,
     "Row goal for the batch-coalesce layer (TargetSize analogue).")
@@ -410,6 +413,17 @@ FAULTS_SPEC = conf_str(
     "raises the named error class (or stalls, for slow=<dur>); @N+ "
     "fires from the Nth call onward.  Call counters reset per query.  "
     "Empty disables injection.")
+TASK_MAX_FAILURES = conf_int(
+    "spark.rapids.task.maxFailures", 0,
+    "Legacy cap on partition replay attempts, honored only when set "
+    "explicitly on the session; otherwise "
+    "spark.rapids.sql.tpu.retry.maxAttempts governs (fault.recovery)."
+    "  0 defers to the retry ladder.")
+SORT_STRING_PREFIX_BYTES = conf_int(
+    "spark.rapids.sql.tpu.sort.stringPrefixBytes", 64,
+    "Bytes of each string sort key encoded into u32 comparison words "
+    "(kernels.sortkeys): order beyond the prefix is approximate "
+    "(documented incompat), larger values cost sort bandwidth.")
 METRICS_DETAIL = conf_bool(
     "spark.rapids.sql.tpu.metrics.detailEnabled", False,
     "Accurate device-time metrics: block on dispatched outputs so "

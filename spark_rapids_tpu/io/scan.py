@@ -21,7 +21,7 @@ import numpy as np
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.batch import HostBatch, HostColumn
 from spark_rapids_tpu.config import (
-    MULTITHREADED_READ_THREADS, RapidsConf,
+    CSV_ENABLED, MULTITHREADED_READ_THREADS, PARQUET_ENABLED, RapidsConf,
 )
 from spark_rapids_tpu.io.arrow_convert import arrow_to_host_batch
 from spark_rapids_tpu.io.discovery import csv_options
@@ -221,9 +221,15 @@ class CpuFileScanExec(CpuExec):
         self.fmt = node.fmt
         self.paths = node.paths
         self.options = node.options
-        self._nthreads = MULTITHREADED_READ_THREADS.get(conf)
+        # per-format acceleration gate: disabled formats decode on one
+        # thread with no row-group pushdown (plain fallback path)
+        accel_entry = {"parquet": PARQUET_ENABLED,
+                       "csv": CSV_ENABLED}.get(node.fmt)
+        accel = accel_entry is None or accel_entry.get(conf)
+        self._nthreads = MULTITHREADED_READ_THREADS.get(conf) if accel else 1
         self.partitions_info = getattr(node, "partitions", None)
-        self.descriptors = extract_pushdown_descriptors(node.pushed_filters)
+        self.descriptors = extract_pushdown_descriptors(
+            node.pushed_filters) if accel else []
         if self.partitions_info is not None:
             # partition pruning: drop whole files whose partition values
             # cannot satisfy the pushed predicates

@@ -80,6 +80,26 @@ def _reserve_for(ctx, batches: List[ColumnBatch], factor: int = 2) -> None:
     DeviceRuntime.get(ctx.conf).catalog.reserve(factor * total)
 
 
+def _release_build_staging(ctx: ExecContext, depth0: int) -> None:
+    """Give back the H2D admission permits taken while materializing a
+    catalog-registered build side.  Build batches park in the spill
+    catalog instead of flowing on to DeviceToHostExec, so the release
+    that normally pairs each staging acquire never happens — without
+    this give-back the task-wide hold depth leaks for the process
+    lifetime, silently shrinking device admission for every later
+    query.  The pipeline collect counts H2D acquires in
+    ``ctx._pipeline_h2d`` and releases that many in its finally, so the
+    count is walked back by the same amount."""
+    sem = ctx.semaphore
+    if sem is None:
+        return
+    extra = max(0, sem.held_depth() - depth0)
+    for _ in range(extra):
+        sem.release()
+    if extra and hasattr(ctx, "_pipeline_h2d"):
+        ctx._pipeline_h2d = max(0, ctx._pipeline_h2d - extra)
+
+
 def _concat_all(batches: List[ColumnBatch], schema: T.Schema,
                 sizes: Optional[List[tuple]] = None
                 ) -> Optional[ColumnBatch]:
@@ -445,11 +465,16 @@ class TpuSortExec(TpuExec):
     for global sorts (GpuSortExec.scala:50-98)."""
 
     def __init__(self, orders: List[SortOrder], key_exprs: List[Expression],
-                 child: PhysicalOp):
+                 child: PhysicalOp, string_prefix_bytes: int = None):
         super().__init__([child], child.output_schema)
         self.orders = orders
         self.key_exprs = key_exprs
         self._input_fns = []
+        if string_prefix_bytes is None:
+            from spark_rapids_tpu.kernels.sort import \
+                DEFAULT_STRING_PREFIX_BYTES
+            string_prefix_bytes = DEFAULT_STRING_PREFIX_BYTES
+        self.string_prefix_bytes = string_prefix_bytes
 
         def run(batch: ColumnBatch) -> ColumnBatch:
             for f in self._input_fns:
@@ -458,7 +483,8 @@ class TpuSortExec(TpuExec):
             vals = [e.tpu_eval(ctx) for e in self.key_exprs]
             return sort_batch(batch, vals,
                               [o.ascending for o in self.orders],
-                              [o.nulls_first for o in self.orders])
+                              [o.nulls_first for o in self.orders],
+                              string_prefix_bytes=self.string_prefix_bytes)
 
         self._run = instrumented_jit(run, label="TpuSort")
 
@@ -1135,6 +1161,7 @@ class TpuNestedLoopJoinExec(TpuExec):
         budget = max(NLJ_PAIR_CAPACITY.get(ctx.conf), 1)
         lsch = self.children[0].output_schema
         rsch = self.children[1].output_schema
+        depth0 = ctx.semaphore.held_depth() if ctx.semaphore else 0
         rbatches = []
         for p in self.children[1].partitions(ctx):
             rbatches.extend(p)
@@ -1150,6 +1177,7 @@ class TpuNestedLoopJoinExec(TpuExec):
             rh = catalog.register(rb)
             ctx.defer_close(rh)
             del rb
+        _release_build_staging(ctx, depth0)
 
         def rb_local():
             return rh.get() if rh is not None else empty_device_batch(rsch)
@@ -1339,6 +1367,7 @@ class TpuBroadcastHashJoinExec(TpuExec):
         cached = self._bc_cache
         if cached is not None and cached[0]() is ctx:
             return cached[1]
+        depth0 = ctx.semaphore.held_depth() if ctx.semaphore else 0
         batches = []
         for p in self.children[1].partitions(ctx):
             batches.extend(p)
@@ -1350,6 +1379,7 @@ class TpuBroadcastHashJoinExec(TpuExec):
             handle = catalog.register(bc)
             ctx.defer_close(handle)
         self._bc_cache = (weakref.ref(ctx), handle)
+        _release_build_staging(ctx, depth0)
         return handle
 
     def partitions(self, ctx):

@@ -30,7 +30,9 @@ from spark_rapids_tpu.plan.physical import (
 )
 from spark_rapids_tpu.utils.compile_registry import instrumented_jit
 
-_RANGE_SAMPLE_ROWS = 4096
+def _range_sample_limit(ctx) -> int:
+    from spark_rapids_tpu.config import CPU_RANGE_PARTITIONING_SAMPLE
+    return max(1, CPU_RANGE_PARTITIONING_SAMPLE.get(ctx.conf))
 
 
 def _collapse_local_conf(ctx) -> bool:
@@ -74,7 +76,8 @@ class CpuShuffleExchangeExec(CpuExec):
         all_batches: List[List[HostBatch]] = [list(p) for p in in_parts]
         if isinstance(self.partitioning, RangePartitioning):
             self.partitioning.prepare(_sample_host_keys(
-                all_batches, self.partitioning.key_ordinals))
+                all_batches, self.partitioning.key_ordinals,
+                _range_sample_limit(ctx)))
         out: List[List[HostBatch]] = [[] for _ in range(n)]
         for pi, batches in enumerate(all_batches):
             for hb in batches:
@@ -91,14 +94,15 @@ class CpuShuffleExchangeExec(CpuExec):
 
 
 def _sample_host_keys(all_batches: List[List[HostBatch]],
-                      key_ordinals: List[int]) -> List[tuple]:
+                      key_ordinals: List[int],
+                      limit: int) -> List[tuple]:
     rows: List[tuple] = []
     for batches in all_batches:
         for hb in batches:
             cols = [hb.columns[i].to_list() for i in key_ordinals]
             for r in range(hb.num_rows):
                 rows.append(tuple(c[r] for c in cols))
-                if len(rows) >= _RANGE_SAMPLE_ROWS:
+                if len(rows) >= limit:
                     return rows
     return rows
 
@@ -214,7 +218,8 @@ class TpuShuffleExchangeExec(TpuExec):
         # device (preserves range ordering / hash co-location)
         part = _mesh_partitioning(self.partitioning, n)
         if isinstance(part, RangePartitioning):
-            part.prepare(_sample_device_keys([batches], part.key_ordinals))
+            part.prepare(_sample_device_keys([batches], part.key_ordinals,
+                                             _range_sample_limit(ctx)))
         per_dev: List[List[ColumnBatch]] = [[] for _ in range(n)]
         for i, b in enumerate(batches):
             per_dev[i % n].append(b)
@@ -289,7 +294,8 @@ class TpuShuffleExchangeExec(TpuExec):
         if isinstance(self.partitioning, RangePartitioning):
             self.partitioning.prepare(
                 _sample_device_keys(all_batches,
-                                    self.partitioning.key_ordinals))
+                                    self.partitioning.key_ordinals,
+                                    _range_sample_limit(ctx)))
         if isinstance(self.partitioning, SinglePartitioning):
             flat = [b for part in all_batches for b in part]
             return [iter(flat)]
@@ -402,7 +408,8 @@ def _mesh_partitioning(p: Partitioning, n: int) -> Partitioning:
 
 
 def _sample_device_keys(all_batches: List[List[ColumnBatch]],
-                        key_ordinals: List[int]) -> List[tuple]:
+                        key_ordinals: List[int],
+                        limit: int) -> List[tuple]:
     rows: List[tuple] = []
     for batches in all_batches:
         for db in batches:
@@ -414,7 +421,7 @@ def _sample_device_keys(all_batches: List[List[ColumnBatch]],
             cols = [c.to_list() for c in hb.columns]
             for r in range(hb.num_rows):
                 rows.append(tuple(c[r] for c in cols))
-                if len(rows) >= _RANGE_SAMPLE_ROWS:
+                if len(rows) >= limit:
                     return rows
     return rows
 

@@ -151,6 +151,7 @@ class TpuOverrides:
             meta.check_exprs(node.condition)
         elif isinstance(node, L.Aggregate):
             meta.check_exprs(*node.keys)
+            self._tag_string_keys(meta, node.keys, "group by")
             for a in node.aggs:
                 meta.check_exprs(a.fn.child)
                 reason = a.fn.tpu_supported(conf)
@@ -166,6 +167,8 @@ class TpuOverrides:
                 meta.check_exprs(o.child)
         elif isinstance(node, L.Join):
             meta.check_exprs(*node.left_keys, *node.right_keys)
+            self._tag_string_keys(
+                meta, list(node.left_keys) + list(node.right_keys), "join")
             if node.condition is not None:
                 # conditions gate matches inside the join kernel for every
                 # join type (GpuHashJoin.scala:265-271 parity)
@@ -194,6 +197,17 @@ class TpuOverrides:
             meta.will_not_work(
                 "pandas exec runs python via the host Arrow path "
                 "(GpuArrowEvalPythonExec data flow)")
+
+    def _tag_string_keys(self, meta: PlanMeta, keys, what: str):
+        """String keys group/join through 64-bit device hashes (documented
+        collision incompat); ``stringHashGroupJoin.enabled=false`` opts the
+        op out to the exact CPU path."""
+        from spark_rapids_tpu.config import STRING_HASH_JOIN
+        if any(k.dtype.is_string for k in keys) and \
+                not STRING_HASH_JOIN.get(self.conf):
+            meta.will_not_work(
+                f"string {what} keys use device 64-bit hashes; disabled "
+                "by spark.rapids.sql.stringHashGroupJoin.enabled")
 
     # -------------------------------------------------------------- convert
 
@@ -447,8 +461,10 @@ class TpuOverrides:
                 TpuShuffleExchangeExec(part, _to_device(child))) \
                 if on_tpu else CpuShuffleExchangeExec(part, _to_host(child))
         if on_tpu:
-            return X.TpuSortExec(orders, [o.child for o in orders],
-                                 _to_device(child))
+            from spark_rapids_tpu.config import SORT_STRING_PREFIX_BYTES
+            return X.TpuSortExec(
+                orders, [o.child for o in orders], _to_device(child),
+                string_prefix_bytes=SORT_STRING_PREFIX_BYTES.get(self.conf))
         return C.CpuSortExec(orders, key_ordinals, _to_host(child))
 
     def _estimate_size(self, node: L.LogicalPlan):

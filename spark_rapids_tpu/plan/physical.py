@@ -54,6 +54,15 @@ class ExecContext:
         # then run lax.all_to_all instead of the single-host split
         self.mesh = mesh
         self.metrics: Dict[str, Dict[str, Metric]] = {}
+        # Net outstanding H2D admission acquires for this query.
+        # HostToDeviceExec counts every semaphore acquire at acquire time;
+        # each per-batch release site decrements; collect_host's finally
+        # releases the residue.  Pairing releases to OUTPUT batches alone
+        # leaks the difference whenever a plan is not 1:1 (a semi join
+        # dropping an empty pair, an n->1 concat on the fallback path),
+        # and a leaked permit silently shrinks device admission for the
+        # rest of the process.
+        self._pipeline_h2d = 0
         # spillable handles whose lifetime is the whole query (shuffle
         # outputs survive partition retries, like the reference's shuffle
         # files); collect_host closes them when the query ends
@@ -72,6 +81,14 @@ class ExecContext:
         if name not in ops:
             ops[name] = Metric(name)
         return ops[name]
+
+
+def _release_admission(ctx: ExecContext, n: int = 1) -> None:
+    """Release ``n`` H2D-paired admission permits and keep the query's
+    outstanding-acquire count in step (``ExecContext._pipeline_h2d``)."""
+    for _ in range(n):
+        ctx.semaphore.release()
+    ctx._pipeline_h2d = max(0, getattr(ctx, "_pipeline_h2d", 0) - n)
 
 
 class PhysicalOp:
@@ -318,7 +335,7 @@ class DeviceToHostExec(CpuExec):
                 # live rows, not padded capacity.
                 hb = device_to_host(shrink_to_fit(db))
                 if ctx.semaphore is not None:
-                    ctx.semaphore.release()
+                    _release_admission(ctx)
                 if hb.num_rows:
                     yield hb
 
@@ -375,8 +392,7 @@ def _drive_partitions(root: PhysicalOp, ctx: ExecContext,
                     got.append(b)
         except BaseException as e:
             if release_partial and ctx.semaphore is not None:
-                for _ in got:
-                    ctx.semaphore.release()
+                _release_admission(ctx, len(got))
             if isinstance(e, MemoryError) or \
                     not isinstance(e, Exception):
                 # MemoryError passes to the caller's handler;
@@ -425,9 +441,8 @@ def _collect_device_bulk(root: PhysicalOp, ctx: ExecContext
         # (DeviceToHostExec's role in the iterator path); CPU-fallback
         # host batches never took device admission
         if ctx.semaphore is not None:
-            for b in flat:
-                if isinstance(b, ColumnBatch):
-                    ctx.semaphore.release()
+            _release_admission(
+                ctx, sum(1 for b in flat if isinstance(b, ColumnBatch)))
 
 
 def _async_collect_enabled(ctx: ExecContext) -> bool:
@@ -471,6 +486,14 @@ def collect_host(op: PhysicalOp, ctx: ExecContext) -> HostBatch:
         return HostBatch.concat(batches)
     finally:
         ctx.close_deferred()
+        # Give back any staging acquires whose batches never reached a
+        # per-batch release (dropped-empty join pairs, n->1 concats):
+        # the query is over, so the outstanding count must drain to zero
+        # or the permit leaks for the process lifetime.  The plan
+        # verifier (analysis/plan_verify.py) asserts the resulting
+        # held_depth() == 0 after every suite query.
+        if ctx.semaphore is not None:
+            _release_admission(ctx, getattr(ctx, "_pipeline_h2d", 0))
 
 
 def _empty_host_col(f: T.Field):

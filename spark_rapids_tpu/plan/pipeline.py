@@ -468,9 +468,11 @@ def pipeline_collect(root: PhysicalOp, ctx: ExecContext
         outs = _run_stage(root, ctx)
         hbs = [hb for hb in device_to_host_many(outs) if hb.num_rows]
     finally:
+        from spark_rapids_tpu.plan.physical import _release_admission
         if ctx.semaphore is not None:
-            for _ in range(getattr(ctx, "_pipeline_h2d", 0)):
-                ctx.semaphore.release()
+            _release_admission(ctx, getattr(ctx, "_pipeline_h2d", 0))
+        else:
+            ctx._pipeline_h2d = 0
     if not hbs:
         from spark_rapids_tpu.plan.physical import _empty_host_col
         return HostBatch(root.output_schema, [

@@ -11,6 +11,7 @@ Reference analogues:
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Optional
 
@@ -94,6 +95,13 @@ class DeviceRuntime:
 
     def __init__(self, conf: RapidsConf):
         self.conf = conf
+        # startup pool sizing (GpuDeviceManager.initializeMemory role):
+        # advisory on the accelerator backends, ignored by CPU; setdefault
+        # so an operator's explicit env wins, and a no-op if the backend
+        # already initialized (the fraction only binds at client creation)
+        from spark_rapids_tpu.config import DEVICE_POOL_FRACTION
+        os.environ.setdefault("XLA_PYTHON_CLIENT_MEM_FRACTION",
+                              str(DEVICE_POOL_FRACTION.get(conf)))
         devices = jax.devices()
         tpus = [d for d in devices if d.platform == "tpu"]
         self.device = tpus[0] if tpus else devices[0]

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""rapidslint — the project lint gate.
+
+Usage:
+    python tools/rapidslint.py --check            # CI gate: fail on new
+                                                  # findings or stale
+                                                  # baseline entries
+    python tools/rapidslint.py --write-baseline   # accept current findings
+                                                  # (reasons preserved for
+                                                  # surviving entries, new
+                                                  # entries get TODO reasons
+                                                  # you must fill in)
+    python tools/rapidslint.py --rules            # print the rule catalog
+
+Runtime-free by construction: the linter parses source with ``ast`` and
+never imports the query engine (or jax), so the whole tree checks in
+well under a second (the CI budget is 15s).  See docs/static_analysis.md for the rule
+catalog and suppression syntax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_analysis():
+    """Load spark_rapids_tpu.analysis WITHOUT executing the engine's
+    package __init__ (which imports jax and flips global config) — the
+    analysis package uses relative imports precisely so the lint gate
+    stays a plain-ast tool with no runtime footprint."""
+    pkg_dir = os.path.join(REPO_ROOT, "spark_rapids_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        "rapidslint_analysis", os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["rapidslint_analysis"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_analysis = _load_analysis()
+from rapidslint_analysis.engine import (  # noqa: E402
+    Baseline, LintEngine, discover_files,
+)
+from rapidslint_analysis.rules import default_rules  # noqa: E402
+
+BASELINE_PATH = os.path.join(REPO_ROOT, "tools", "rapidslint_baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--check", action="store_true",
+                    help="exit non-zero on new findings / stale baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write the current findings as the baseline")
+    ap.add_argument("--rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--root", default=REPO_ROOT, help=argparse.SUPPRESS)
+    ap.add_argument("--baseline", default=BASELINE_PATH,
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    rules = default_rules()
+    if args.rules:
+        for r in rules:
+            print(f"{r.id}  {r.name}: {r.description}")
+        return 0
+
+    t0 = time.monotonic()
+    files = discover_files(args.root)
+    engine = LintEngine(rules)
+    findings = engine.run(files, args.root)
+    baseline = Baseline.load(args.baseline)
+    new, used, stale = baseline.partition(findings)
+    dt = time.monotonic() - t0
+
+    if args.write_baseline:
+        entries = []
+        for f in findings:
+            reason = None
+            for e in used:
+                if (e.get("rule"), e.get("path")) == (f.rule_id, f.path) \
+                        and e.get("line", "").split() == \
+                        f.line_text.split():
+                    reason = e.get("reason")
+                    break
+            entries.append({
+                "rule": f.rule_id,
+                "path": f.path,
+                "line": " ".join(f.line_text.split()),
+                "reason": reason or "TODO: justify this suppression",
+            })
+        Baseline(entries).save(args.baseline)
+        print(f"wrote {len(entries)} baseline entries to {args.baseline}")
+        return 0
+
+    for f in new:
+        print(f"{f.path}:{f.line}: {f.severity} [{f.rule_id}] {f.message}")
+    for e in stale:
+        print(f"{e.get('path')}: stale baseline entry "
+              f"[{e.get('rule')}] for line `{e.get('line')}` — the code "
+              "it excused is gone; remove the entry")
+    status = "clean" if not new and not stale else \
+        f"{len(new)} new finding(s), {len(stale)} stale entr(y/ies)"
+    print(f"rapidslint: {len(files)} files, {len(findings)} finding(s) "
+          f"({len(used)} baselined), {status} [{dt:.2f}s]")
+    if args.check and (new or stale):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
