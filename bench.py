@@ -315,6 +315,31 @@ def _bytes_per_row(data) -> int:
     return sum(int(np.asarray(v).dtype.itemsize) for _, v in data.values())
 
 
+def time_shuffle():
+    """Single-host shuffle split microbench: a non-collapsed round-robin
+    exchange (B=4 input partitions -> N=8 targets), reporting the split
+    engine's economics — throughput from the split's own byte/wall
+    accounting plus the dispatch/sync counts the v2 coalescing engine
+    minimizes (~B+N dispatches, exactly 1 host sync per exchange)."""
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.session import TpuSparkSession
+    rows = min(ROWS, 1 << 20)
+    s = TpuSparkSession(RapidsConf({
+        "spark.rapids.sql.enabled": True,
+        "spark.sql.shuffle.partitions": 8,
+        "spark.rapids.sql.tpu.exchange.collapseLocal": False,
+        "spark.rapids.sql.variableFloatAgg.enabled": True,
+    }))
+    df = s.create_dataframe(make_data(rows), num_partitions=4)
+    q = df.repartition(8)
+    q.collect()  # warmup (compile)
+    q.collect()
+    m = s.last_metrics
+    wall = m.get("shuffleWallNs", 0)
+    gbps = round(m.get("shuffleBytes", 0) / wall, 3) if wall else 0.0
+    return gbps, m.get("shuffleSplitDispatches", 0), m.get("shuffleSyncs", 0)
+
+
 def _async_partitions_default() -> bool:
     from spark_rapids_tpu.config import PIPELINE_ASYNC_PARTITIONS, RapidsConf
     return bool(PIPELINE_ASYNC_PARTITIONS.get(RapidsConf()))
@@ -361,6 +386,7 @@ def main():
         df.write_parquet(scan_dir, mode="overwrite")
     scan_tpu = time_scan_engine(True, scan_dir)
     scan_cpu = time_scan_engine(False, scan_dir)
+    shuffle_gbps, shuffle_dispatches, shuffle_syncs = time_shuffle()
 
     data_bytes = ROWS * _bytes_per_row(data)
     device_s = tpu_econ["device_ms"] / 1e3
@@ -390,6 +416,12 @@ def main():
         "donated_bytes": tpu_econ["donated_bytes"],
         "h2d_gb_per_sec": tpu_econ["h2d_gb_per_sec"],
         "d2h_gb_per_sec": tpu_econ["d2h_gb_per_sec"],
+        # shuffle split engine economics (non-collapsed exchange
+        # microbench): split throughput plus the dispatch/sync counts the
+        # one-sync coalescing split minimizes
+        "shuffle_gb_per_sec": shuffle_gbps,
+        "shuffle_split_dispatches": shuffle_dispatches,
+        "shuffle_syncs": shuffle_syncs,
         "async_partitions": _async_partitions_default(),
         # fault-tolerance counters for the steady-state run (fault/)
         "retry_count": tpu_econ["retry_count"],

@@ -61,13 +61,15 @@ lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
 assert lines, f"no JSON line in bench output:\n{out.stdout[-2000:]}"
 j = json.loads(lines[-1])
 for key in ("value", "donated_bytes", "h2d_gb_per_sec", "d2h_gb_per_sec",
-            "async_partitions", "dispatch_count",
+            "shuffle_gb_per_sec", "shuffle_split_dispatches",
+            "shuffle_syncs", "async_partitions", "dispatch_count",
             "retry_count", "device_lost_count", "partition_fallbacks",
             "faults_injected"):
     assert key in j, f"bench JSON missing {key}: {sorted(j)}"
 assert j["value"] > 0, j
 print("bench smoke ok:", {k: j[k] for k in (
     "value", "donated_bytes", "h2d_gb_per_sec", "d2h_gb_per_sec",
+    "shuffle_gb_per_sec", "shuffle_split_dispatches", "shuffle_syncs",
     "async_partitions", "retry_count", "device_lost_count")})
 PY
 
@@ -98,6 +100,42 @@ assert m["faultsInjected"] >= 1, m
 print("fault smoke ok:", {k: m[k] for k in (
     "retryCount", "faultsInjected", "deviceLostCount",
     "partitionFallbackCount", "backoffWallNs")})
+PY
+
+echo "== fault-injection smoke: exchange:oom@2 must replay the coalesced"
+echo "   shuffle split through the retry ladder (split v2 path)"
+python - << 'PY'
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.session import TpuSparkSession
+
+def make(s):
+    df = s.create_dataframe(
+        {"k": [i % 7 for i in range(4096)],
+         "v": list(range(4096))}, num_partitions=2)
+    # two non-collapsed exchanges (hash groupby + range order_by): the
+    # @2 rule fires on the SECOND exchange-site call of the query
+    return df.group_by("k").sum("v").order_by("k")
+
+clean = TpuSparkSession(RapidsConf({
+    "spark.rapids.sql.enabled": True,
+    "spark.rapids.sql.tpu.exchange.collapseLocal": False,
+}))
+want = make(clean).collect()
+
+s = TpuSparkSession(RapidsConf({
+    "spark.rapids.sql.enabled": True,
+    "spark.rapids.sql.tpu.exchange.collapseLocal": False,
+    "spark.rapids.sql.tpu.faults.spec": "exchange:oom@2",
+}))
+got = make(s).collect()
+assert got == want, f"faulted run diverged:\n{got[:5]}\n{want[:5]}"
+m = s.last_metrics
+assert m["retryCount"] > 0, m
+assert m["faultsInjected"] >= 1, m
+assert m["shuffleSyncs"] >= 1, m
+print("exchange fault smoke ok:", {k: m[k] for k in (
+    "retryCount", "faultsInjected", "shuffleSyncs",
+    "shuffleSplitDispatches", "shufflePieces")})
 PY
 
 echo "== single-chip entry compile check"

@@ -346,6 +346,20 @@ EXCHANGE_COLLAPSE_LOCAL = conf_bool(
     "single-process execution: partitioning only constrains placement, "
     "which one partition trivially satisfies, so the per-batch pid "
     "compute + split is pure overhead on one device.")
+SHUFFLE_SPLIT_V2 = conf_bool(
+    "spark.rapids.sql.tpu.exchange.splitV2.enabled", True,
+    "Use the one-sync coalescing shuffle split: every input batch's "
+    "pid-sort program is dispatched before ONE bulk count/byte-total "
+    "fetch, then each target partition is assembled from all sorted "
+    "batches by a single k-way segment-gather dispatch (<=N pieces, "
+    "~B+N dispatches).  false restores the legacy per-batch split "
+    "(B host syncs, one gather per batch x partition pair).")
+SHUFFLE_COALESCE_MAX_BYTES = conf_bytes(
+    "spark.rapids.sql.tpu.exchange.splitCoalesceMaxBytes", 256 << 20,
+    "Spill-budget cap for the coalescing shuffle split: a target "
+    "partition whose combined size exceeds this stays as per-batch "
+    "pieces so the catalog can spill early pieces while later input "
+    "batches still materialize.  <=0 coalesces unconditionally.")
 PIPELINE_FUSE_TAIL = conf_bool(
     "spark.rapids.sql.tpu.pipeline.fuseTail.enabled", True,
     "Fuse the stage-break re-bucketing gather into the consuming (tail) "

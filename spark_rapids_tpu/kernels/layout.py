@@ -251,6 +251,124 @@ def concat_kway_run(batches: Sequence[ColumnBatch], out_capacity: int,
 _CONCAT_KWAY_JIT = None
 
 
+def gather_segments_kway(batches: Sequence[ColumnBatch], starts, counts,
+                         out_capacity: int,
+                         out_byte_caps: Optional[Sequence[int]] = None
+                         ) -> ColumnBatch:
+    """Gather one contiguous row segment per input batch into ONE packed
+    output batch: input j contributes rows ``[starts[j], starts[j]+counts[j])``
+    at output row offset ``sum(counts[:j])``.
+
+    This is the shuffle split's coalescing primitive: each input is a
+    pid-sorted batch whose target-partition rows are contiguous, so one
+    call assembles a whole target partition from every input batch — the
+    write-combining replacement for one :func:`gather_rows` per
+    (batch, partition) pair.  Same scatter shape as :func:`concat_kway`:
+    every input is written exactly once at its row/byte offset, and rows
+    outside the segment target genuinely unique out-of-bounds slots
+    (``out_capacity + i``) so ``mode="drop"`` discards them while the
+    ``unique_indices`` promise stays true.
+
+    ``starts``/``counts`` are traced int32 scalars — different segment
+    positions ride the same compiled program (the cache keys only on input
+    capacity buckets and the static output caps).  Segments must lie
+    within each input's live rows, so the varlen byte window
+    ``offsets[start] .. offsets[start+count]`` covers exactly the
+    segment's live bytes (offsets are constant past ``num_rows`` by
+    construction; see concat_kway's live-bytes note).
+    """
+    assert batches
+    schema = batches[0].schema
+    for b in batches[1:]:
+        assert b.schema == schema, f"{b.schema} != {schema}"
+    starts = [jnp.asarray(s, jnp.int32) for s in starts]
+    counts = [jnp.asarray(c, jnp.int32) for c in counts]
+    row_offs = []
+    acc = jnp.asarray(0, jnp.int32)
+    for c in counts:
+        row_offs.append(acc)
+        acc = acc + c
+    total = acc.astype(jnp.int32)
+
+    def scatter_segments(init, values_per_batch):
+        out = init
+        for j, (b, vals) in enumerate(zip(batches, values_per_batch)):
+            iota = jnp.arange(b.capacity, dtype=jnp.int32)
+            rel = iota - starts[j]
+            in_seg = (rel >= 0) & (rel < counts[j])
+            tgt = jnp.where(in_seg, row_offs[j] + rel, out_capacity + iota)
+            out = out.at[tgt].set(vals, mode="drop", unique_indices=True)
+        return out
+
+    cols = []
+    str_i = 0
+    for ci, f in enumerate(schema.fields):
+        parts = [b.columns[ci] for b in batches]
+        validity = scatter_segments(jnp.zeros(out_capacity, dtype=jnp.bool_),
+                                    [c.validity for c in parts])
+        if parts[0].is_varlen:
+            bcap = (out_byte_caps[str_i] if out_byte_caps is not None
+                    else sum(int(c.data.shape[0]) for c in parts))
+            str_i += 1
+            lens = scatter_segments(jnp.zeros(out_capacity, dtype=jnp.int32),
+                                    [_string_lengths(c) for c in parts])
+            new_offsets = jnp.concatenate([
+                jnp.zeros(1, dtype=jnp.int32),
+                jnp.cumsum(lens).astype(jnp.int32),
+            ])
+            data = jnp.zeros(bcap, dtype=parts[0].data.dtype)
+            byte_off = jnp.asarray(0, jnp.int32)
+            for c, s, n in zip(parts, starts, counts):
+                lo = c.offsets[s]
+                hi = c.offsets[s + n]
+                biota = jnp.arange(int(c.data.shape[0]), dtype=jnp.int32)
+                brel = biota - lo
+                in_seg = (brel >= 0) & (biota < hi)
+                tgt = jnp.where(in_seg, byte_off + brel, bcap + biota)
+                data = data.at[tgt].set(c.data, mode="drop",
+                                        unique_indices=True)
+                byte_off = byte_off + (hi - lo)
+            cols.append(DeviceColumn(f.dtype, data, validity, new_offsets))
+        else:
+            data = scatter_segments(
+                jnp.zeros(out_capacity, dtype=parts[0].data.dtype),
+                [c.data for c in parts])
+            cols.append(DeviceColumn(f.dtype, data, validity, None))
+    return ColumnBatch(schema, cols, total, out_capacity)
+
+
+def _gather_segments_kway_tuple(batches, starts, counts, out_capacity,
+                                out_byte_caps):
+    return gather_segments_kway(
+        list(batches), list(starts), list(counts), out_capacity,
+        list(out_byte_caps) if out_byte_caps else None)
+
+
+def gather_segments_kway_run(batches: Sequence[ColumnBatch], starts, counts,
+                             out_capacity: int,
+                             out_byte_caps: Optional[Sequence[int]] = None
+                             ) -> ColumnBatch:
+    """Eager-path entry: ONE compiled dispatch assembles a whole target
+    partition from k pid-sorted batches.  Segment positions are traced, so
+    every partition of a shuffle (and every repeat query) reuses the same
+    executable per (input bucket tuple, output caps)."""
+    from spark_rapids_tpu.utils.compile_registry import instrumented_jit
+    global _GATHER_SEGMENTS_KWAY_JIT
+    if _GATHER_SEGMENTS_KWAY_JIT is None:
+        _GATHER_SEGMENTS_KWAY_JIT = instrumented_jit(
+            _gather_segments_kway_tuple, label="kernels:gatherSegmentsKway",
+            static_argnames=("out_capacity", "out_byte_caps"))
+    return _GATHER_SEGMENTS_KWAY_JIT(
+        tuple(batches),
+        tuple(jnp.asarray(s, jnp.int32) for s in starts),
+        tuple(jnp.asarray(c, jnp.int32) for c in counts),
+        out_capacity,
+        tuple(out_byte_caps) if out_byte_caps else None)
+
+
+_GATHER_SEGMENTS_KWAY_JIT = None
+
+
 def concat_pair(a: ColumnBatch, b: ColumnBatch, out_capacity: int,
                 out_byte_caps: Optional[Sequence[int]] = None) -> ColumnBatch:
     """Concatenate two batches (same schema) into one of static capacity.

@@ -17,10 +17,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from spark_rapids_tpu import types as T
-from spark_rapids_tpu.batch import (
-    ColumnBatch, HostBatch, HostColumn, device_to_host,
-)
-from spark_rapids_tpu.kernels.layout import compact, gather_rows
+from spark_rapids_tpu.batch import ColumnBatch, HostBatch, HostColumn
+from spark_rapids_tpu.kernels.layout import gather_rows
 from spark_rapids_tpu.parallel.partitioning import (
     HashPartitioning, Partitioning, RangePartitioning,
     RoundRobinPartitioning, SinglePartitioning,
@@ -82,13 +80,21 @@ class CpuShuffleExchangeExec(CpuExec):
         for pi, batches in enumerate(all_batches):
             for hb in batches:
                 ids = self.partitioning.host_partition_ids(hb, pi)
+                # ONE stable argsort + split instead of N boolean-mask
+                # scans: the stable sort keeps each target's rows in
+                # original order (the deterministic order the compare
+                # harness and mixed CPU/TPU plans rely on)
+                order = np.argsort(ids, kind="stable")
+                counts = np.bincount(ids, minlength=n)
+                cuts = np.cumsum(counts)[:-1]
+                split_cols = [(np.split(c.values[order], cuts),
+                               np.split(c.validity[order], cuts))
+                              for c in hb.columns]
                 for p in range(n):
-                    keep = ids == p
-                    if not keep.any():
+                    if counts[p] == 0:
                         continue
-                    cols = [HostColumn(c.dtype, c.values[keep],
-                                       c.validity[keep])
-                            for c in hb.columns]
+                    cols = [HostColumn(c.dtype, vs[p], vl[p])
+                            for c, (vs, vl) in zip(hb.columns, split_cols)]
                     out[p].append(HostBatch(hb.schema, cols))
         return [iter(p) for p in out]
 
@@ -160,15 +166,25 @@ class TpuShuffleExchangeExec(TpuExec):
 
         return f
 
-    def _sort_by_pid_impl(self, batch: ColumnBatch, part_index, n: int):
+    def _sort_by_pid_impl(self, batch: ColumnBatch, part_index, n: int,
+                          bound_words=None):
         """One pass: rows reordered so each target partition's rows are
         contiguous (the GPU `Table.partition` + contiguousSplit shape,
         GpuPartitioning.scala:44-117).  Returns (sorted batch, per-target
-        row counts, per-target byte totals for each string column)."""
+        row counts, per-target byte totals for each string column).
+
+        ``bound_words`` (range partitioning only): pre-encoded range-bound
+        word arrays passed as TRACED arguments, so range splits ride the
+        same jitted program as hash/round-robin instead of the eager
+        per-bound path."""
         for f in self._input_fns:
             batch = f(batch)
         cap = batch.capacity
-        ids = self.partitioning.device_partition_ids(batch, part_index)
+        if bound_words is not None:
+            ids = self.partitioning.device_partition_ids_from_words(
+                batch, bound_words)
+        else:
+            ids = self.partitioning.device_partition_ids(batch, part_index)
         live = jnp.arange(cap, dtype=jnp.int32) < batch.num_rows
         ids = jnp.where(live, ids, n)
         order = jnp.argsort(ids, stable=True).astype(jnp.int32)
@@ -299,8 +315,6 @@ class TpuShuffleExchangeExec(TpuExec):
         if isinstance(self.partitioning, SinglePartitioning):
             flat = [b for part in all_batches for b in part]
             return [iter(flat)]
-        from spark_rapids_tpu.batch import round_up_capacity
-        from spark_rapids_tpu.mem.catalog import PRIORITY_SHUFFLE_OUTPUT
         from spark_rapids_tpu.runtime.device import DeviceRuntime
         # Shuffle outputs accumulate across ALL partitions before any
         # consumer runs — exactly the working set the reference keeps in the
@@ -328,11 +342,110 @@ class TpuShuffleExchangeExec(TpuExec):
         from spark_rapids_tpu.batch import (
             fixed_row_bytes, varlen_byte_scales,
         )
+        from spark_rapids_tpu.config import SHUFFLE_SPLIT_V2
         frb = fixed_row_bytes(self.output_schema)
         vscales = varlen_byte_scales(self.output_schema)
         out: List[List] = [[] for _ in range(n)]
         import time as _time
         t0 = _time.monotonic_ns()
+        if SHUFFLE_SPLIT_V2.get(ctx.conf):
+            self._split_v2(ctx, all_batches, n, catalog, frb, vscales, out)
+        else:
+            self._split_v1(ctx, all_batches, n, catalog, frb, vscales, out)
+        ctx.metric(self.op_id, "shufflePieces").add(
+            sum(len(p) for p in out))
+        # downstream AQE coalescing reads these instead of unspilling
+        # batches just to count rows (GpuCustomShuffleReaderExec's use of
+        # map-status sizes)
+        self._last_part_rows = [sum(h.piece_rows for h in p) for p in out]
+        self._last_part_bytes = [sum(h.piece_bytes for h in p) for p in out]
+        # write-side shuffle metrics (single-host split path).  Wall time
+        # covers pid-sort + the count sync(s); the final piece gathers may
+        # still be in flight (async dispatch), so this is a lower bound on
+        # split cost, not an upper
+        ctx.metric(self.op_id, "shuffleBytes").add(
+            sum(self._last_part_bytes))
+        ctx.metric(self.op_id, "shuffleRows").add(sum(self._last_part_rows))
+        ctx.metric(self.op_id, "shuffleWallNs").add(
+            _time.monotonic_ns() - t0)
+        self._split_cache = (weakref.ref(ctx), out, gen)
+        return [self._drain_cached(p) for p in out]
+
+    def _split_v2(self, ctx, all_batches, n, catalog, frb, vscales, out):
+        """One-sync coalescing split: (1) dispatch the fused pid-sort
+        program for EVERY input batch (nothing blocks, so B programs
+        overlap on device); (2) fetch every batch's per-target counts and
+        varlen byte totals in ONE bulk device_get (the host_sizes
+        pattern); (3) assemble each target partition from ALL sorted
+        batches with one k-way segment-gather dispatch — <=N pieces and
+        ~B+N dispatches where the v1 path paid B syncs and B*(1+N)
+        dispatches.  Spill-budget-aware: a partition whose coalesced size
+        exceeds splitCoalesceMaxBytes falls back to per-batch pieces so
+        the catalog can still spill early pieces independently."""
+        from spark_rapids_tpu.batch import round_up_capacity
+        from spark_rapids_tpu.config import SHUFFLE_COALESCE_MAX_BYTES
+        from spark_rapids_tpu.kernels.layout import gather_segments_kway_run
+        from spark_rapids_tpu.mem.catalog import PRIORITY_SHUFFLE_OUTPUT
+        bound_words = None
+        if isinstance(self.partitioning, RangePartitioning):
+            # one batched H2D + one encode for ALL N-1 bounds; the word
+            # arrays ride the jitted pid-sort as traced arguments
+            bound_words = self.partitioning.encode_bounds_device()
+        sorted_all = []
+        for pi, batches in enumerate(all_batches):
+            for db in batches:
+                sorted_all.append(self._sort_by_pid(db, pi, n, bound_words))
+                ctx.metric(self.op_id, "shuffleSplitDispatches").add(1)
+        if not sorted_all:
+            return
+        host = jax.device_get([(c, bt) for _, c, bt in sorted_all])
+        ctx.metric(self.op_id, "shuffleSyncs").add(1)
+        counts_h = [np.asarray(c, dtype=np.int64) for c, _ in host]
+        bytes_h = [[np.asarray(b, dtype=np.int64) for b in bt]
+                   for _, bt in host]
+        starts_h = [np.concatenate(([0], np.cumsum(c)))[:n]
+                    for c in counts_h]
+        cap_bytes = SHUFFLE_COALESCE_MAX_BYTES.get(ctx.conf)
+        for p in range(n):
+            segs = [b for b in range(len(sorted_all))
+                    if counts_h[b][p] > 0]
+            if not segs:
+                continue
+            total_rows = sum(int(counts_h[b][p]) for b in segs)
+            total_bytes = total_rows * frb + sum(
+                int(bytes_h[b][ci][p]) * sc
+                for b in segs for ci, sc in enumerate(vscales))
+            if cap_bytes > 0 and total_bytes > cap_bytes and len(segs) > 1:
+                groups = [[b] for b in segs]
+            else:
+                groups = [segs]
+            for group in groups:
+                rows = sum(int(counts_h[b][p]) for b in group)
+                elems = [sum(int(bytes_h[b][ci][p]) for b in group)
+                         for ci in range(len(vscales))]
+                pcap = round_up_capacity(rows)
+                bcaps = [round_up_capacity(max(e, 16), minimum=16)
+                         for e in elems]
+                piece = gather_segments_kway_run(
+                    [sorted_all[b][0] for b in group],
+                    [int(starts_h[b][p]) for b in group],
+                    [int(counts_h[b][p]) for b in group],
+                    pcap, bcaps or None)
+                ctx.metric(self.op_id, "shuffleSplitDispatches").add(1)
+                h = catalog.register(piece, PRIORITY_SHUFFLE_OUTPUT)
+                h.piece_rows = rows  # host-known: no sync for AQE sizing
+                h.piece_bytes = rows * frb + sum(
+                    e * sc for e, sc in zip(elems, vscales))
+                ctx.defer_close(h)
+                out[p].append(h)
+
+    def _split_v1(self, ctx, all_batches, n, catalog, frb, vscales, out):
+        """Legacy per-batch split (one count sync per batch, one gather
+        dispatch per (batch, target) pair) — kept behind
+        splitV2.enabled=false as the bit-parity oracle for the coalescing
+        engine."""
+        from spark_rapids_tpu.batch import round_up_capacity
+        from spark_rapids_tpu.mem.catalog import PRIORITY_SHUFFLE_OUTPUT
         for pi, batches in enumerate(all_batches):
             for db in batches:
                 sorted_batch, counts, byte_totals = \
@@ -340,9 +453,11 @@ class TpuShuffleExchangeExec(TpuExec):
                     if not isinstance(self.partitioning,
                                       RangePartitioning) \
                     else self._sort_by_pid_impl(db, pi, n)
+                ctx.metric(self.op_id, "shuffleSplitDispatches").add(1)
                 counts_h = np.asarray(jax.device_get(counts))
                 bytes_h = [np.asarray(jax.device_get(b))
                            for b in byte_totals]
+                ctx.metric(self.op_id, "shuffleSyncs").add(1)
                 offset = 0
                 for p in range(n):
                     cnt = int(counts_h[p])
@@ -353,12 +468,11 @@ class TpuShuffleExchangeExec(TpuExec):
                     bcaps = [round_up_capacity(max(int(bh[p]), 16),
                                                minimum=16)
                              for bh in bytes_h]
-                    from spark_rapids_tpu.kernels.layout import gather_rows \
-                        as _gather
-                    piece = _gather(sorted_batch, idx,
-                                    jnp.asarray(cnt, jnp.int32),
-                                    out_capacity=pcap,
-                                    out_byte_caps=bcaps or None)
+                    piece = gather_rows(sorted_batch, idx,
+                                        jnp.asarray(cnt, jnp.int32),
+                                        out_capacity=pcap,
+                                        out_byte_caps=bcaps or None)
+                    ctx.metric(self.op_id, "shuffleSplitDispatches").add(1)
                     h = catalog.register(piece, PRIORITY_SHUFFLE_OUTPUT)
                     h.piece_rows = cnt  # host-known: no sync for AQE sizing
                     h.piece_bytes = cnt * frb + \
@@ -368,30 +482,20 @@ class TpuShuffleExchangeExec(TpuExec):
                     out[p].append(h)
                     offset += cnt
 
-        # downstream AQE coalescing reads these instead of unspilling
-        # batches just to count rows (GpuCustomShuffleReaderExec's use of
-        # map-status sizes)
-        self._last_part_rows = [sum(h.piece_rows for h in p) for p in out]
-        self._last_part_bytes = [sum(h.piece_bytes for h in p) for p in out]
-        # write-side shuffle metrics (single-host split path).  Wall time
-        # covers pid-sort + per-batch count syncs; the final batch's piece
-        # gathers may still be in flight (async dispatch), so this is a
-        # lower bound on split cost, not an upper
-        ctx.metric(self.op_id, "shuffleBytes").add(
-            sum(self._last_part_bytes))
-        ctx.metric(self.op_id, "shuffleRows").add(sum(self._last_part_rows))
-        ctx.metric(self.op_id, "shuffleWallNs").add(
-            _time.monotonic_ns() - t0)
-        self._split_cache = (weakref.ref(ctx), out, gen)
-        return [self._drain_cached(p) for p in out]
-
     @staticmethod
     def _drain_cached(handles):
-        # lazy: each piece unspills only when the consumer reaches it;
-        # handles stay registered (spillable + retry-reusable) until the
-        # query closes them
-        for h in handles:
-            yield h.get()
+        # lazy, with ONE piece of read-ahead: when piece i is yielded,
+        # piece i+1's unspill (an async H2D enqueue) is already in flight,
+        # so the consumer's compute overlaps the next transfer.  Handles
+        # stay registered (spillable + retry-reusable) until the query
+        # closes them
+        if not handles:
+            return
+        nxt = handles[0].get()
+        for i in range(len(handles)):
+            cur = nxt
+            nxt = handles[i + 1].get() if i + 1 < len(handles) else None
+            yield cur
 
 
 def _mesh_partitioning(p: Partitioning, n: int) -> Partitioning:
@@ -410,19 +514,48 @@ def _mesh_partitioning(p: Partitioning, n: int) -> Partitioning:
 def _sample_device_keys(all_batches: List[List[ColumnBatch]],
                         key_ordinals: List[int],
                         limit: int) -> List[tuple]:
+    """Sample <= ``limit`` key rows for range-bound computation.
+
+    The keys are gathered down to the sample size ON DEVICE before any
+    transfer: one bulk metadata get (num_rows + varlen offsets — bytes
+    proportional to row count, not payload), then a right-sized head
+    gather per contributing batch, then ONE bulk D2H for all gathered
+    sub-batches.  The old path device_to_host'd every FULL batch (values
+    included) just to read the first rows."""
+    from spark_rapids_tpu.batch import device_to_host_many, round_up_capacity
     rows: List[tuple] = []
-    for batches in all_batches:
-        for db in batches:
-            sub = ColumnBatch(
+    subs = [ColumnBatch(
                 T.Schema([db.schema.fields[i] for i in key_ordinals]),
                 [db.columns[i] for i in key_ordinals], db.num_rows,
                 db.capacity)
-            hb = device_to_host(sub)
-            cols = [c.to_list() for c in hb.columns]
-            for r in range(hb.num_rows):
-                rows.append(tuple(c[r] for c in cols))
-                if len(rows) >= limit:
-                    return rows
+            for batches in all_batches for db in batches]
+    if not subs:
+        return rows
+    meta = jax.device_get([
+        (b.num_rows, [c.offsets for c in b.columns if c.is_varlen])
+        for b in subs])
+    gathered = []
+    remaining = limit
+    for sub, (nr, off_arrays) in zip(subs, meta):
+        if remaining <= 0:
+            break
+        take = min(int(nr), remaining)
+        if take <= 0:
+            continue
+        pcap = round_up_capacity(take)
+        bcaps = [round_up_capacity(max(int(offs[take]), 16), minimum=16)
+                 for offs in off_arrays]
+        gathered.append(gather_rows(
+            sub, jnp.arange(pcap, dtype=jnp.int32),
+            jnp.asarray(take, jnp.int32),
+            out_capacity=pcap, out_byte_caps=bcaps or None))
+        remaining -= take
+    for hb in device_to_host_many(gathered):
+        cols = [c.to_list() for c in hb.columns]
+        for r in range(hb.num_rows):
+            rows.append(tuple(c[r] for c in cols))
+            if len(rows) >= limit:
+                return rows
     return rows
 
 

@@ -99,11 +99,13 @@ class RangePartitioning(Partitioning):
         self.key_ordinals = key_ordinals
         self.num_partitions = num_partitions
         self.bound_rows: Optional[List[tuple]] = None  # host key tuples
+        self._bound_words: Optional[tuple] = None  # device word arrays
 
     def prepare(self, sample_rows):
         """sample_rows: list of key tuples sampled from the input."""
         from spark_rapids_tpu.ops.cpu_exec import sort_key_fn
         n = self.num_partitions
+        self._bound_words = None
         if not sample_rows or n <= 1:
             self.bound_rows = []
             return
@@ -161,6 +163,67 @@ class RangePartitioning(Partitioning):
                 eq = eq & (w == bw)
             pid = pid + gt.astype(jnp.int32)
         return pid
+
+    def encode_bounds_device(self) -> tuple:
+        """ALL N-1 bounds encoded with ONE batched host_to_device + ONE
+        encode_sort_keys call (vs one H2D per bound in the eager
+        :meth:`_encode_bound` path).  Returns a tuple of per-word device
+        arrays, each shaped [num_bounds] — pytree-friendly, so the
+        exchange passes them as traced arguments and range splits ride
+        the jitted pid-sort program like hash/round-robin.  Cached until
+        the next :meth:`prepare` resamples the bounds."""
+        assert self.bound_rows is not None, "range bounds not prepared"
+        if self._bound_words is not None:
+            return self._bound_words
+        if not self.bound_rows:
+            self._bound_words = ()
+            return self._bound_words
+        from spark_rapids_tpu.batch import HostBatch, HostColumn, \
+            host_to_device
+        from spark_rapids_tpu.exprs.base import DevVal
+        from spark_rapids_tpu.kernels.sortkeys import encode_sort_keys
+        nb = len(self.bound_rows)
+        fields, cols = [], []
+        for i, o in enumerate(self.orders):
+            dt = o.child.dtype
+            fields.append((f"b{i}", dt))
+            cols.append(HostColumn.from_list(
+                dt, [b[i] for b in self.bound_rows]))
+        hb = HostBatch(T.Schema(fields), cols)
+        db = host_to_device(hb, capacity=nb)
+        vals = [DevVal.from_column(c) for c in db.columns]
+        ascs = [o.ascending for o in self.orders]
+        nfs = [o.nulls_first for o in self.orders]
+        words = encode_sort_keys(vals, ascs, nfs, db.num_rows,
+                                 liveness=False)
+        self._bound_words = tuple(w[:nb] for w in words)
+        return self._bound_words
+
+    def device_partition_ids_from_words(self, batch: ColumnBatch,
+                                        bound_words: tuple):
+        """Vectorized pid: ONE lexicographic compare of every row against
+        ALL bounds (broadcast over a [cap, num_bounds] grid) — jit-safe,
+        since the bounds arrive as traced word arrays instead of per-bound
+        eager encodes.  pid = number of bounds the row exceeds, identical
+        to the per-bound loop in :meth:`device_partition_ids`."""
+        from spark_rapids_tpu.exprs.base import DevVal
+        from spark_rapids_tpu.kernels.sortkeys import encode_sort_keys
+        cap = batch.capacity
+        if not bound_words:
+            return jnp.zeros(cap, dtype=jnp.int32)
+        vals = [DevVal.from_column(batch.columns[i])
+                for i in self.key_ordinals]
+        ascs = [o.ascending for o in self.orders]
+        nfs = [o.nulls_first for o in self.orders]
+        words = encode_sort_keys(vals, ascs, nfs, batch.num_rows,
+                                 liveness=False)
+        nb = int(bound_words[0].shape[0])
+        gt = jnp.zeros((cap, nb), dtype=jnp.bool_)
+        eq = jnp.ones((cap, nb), dtype=jnp.bool_)
+        for w, bw in zip(words, bound_words):
+            gt = gt | (eq & (w[:, None] > bw[None, :]))
+            eq = eq & (w[:, None] == bw[None, :])
+        return jnp.sum(gt, axis=1).astype(jnp.int32)
 
     def _encode_bound(self, bound: tuple) -> list:
         """Encode one host bound row with the same word scheme as

@@ -210,6 +210,24 @@ class TpuSparkSession:
         self.last_metrics["deviceTimeNs"] = sum(
             ms["deviceTimeNs"].value for ms in ctx.metrics.values()
             if "deviceTimeNs" in ms)
+        # shuffle split economics, summed over every exchange op: split
+        # programs dispatched, blocking host syncs paid, catalog pieces
+        # registered, and the bytes/wall the split moved (GB/s derivable)
+        self.last_metrics["shuffleSplitDispatches"] = sum(
+            ms["shuffleSplitDispatches"].value for ms in ctx.metrics.values()
+            if "shuffleSplitDispatches" in ms)
+        self.last_metrics["shuffleSyncs"] = sum(
+            ms["shuffleSyncs"].value for ms in ctx.metrics.values()
+            if "shuffleSyncs" in ms)
+        self.last_metrics["shufflePieces"] = sum(
+            ms["shufflePieces"].value for ms in ctx.metrics.values()
+            if "shufflePieces" in ms)
+        self.last_metrics["shuffleBytes"] = sum(
+            ms["shuffleBytes"].value for ms in ctx.metrics.values()
+            if "shuffleBytes" in ms)
+        self.last_metrics["shuffleWallNs"] = sum(
+            ms["shuffleWallNs"].value for ms in ctx.metrics.values()
+            if "shuffleWallNs" in ms)
         # fault-tolerance economics (fault.metrics deltas): recovery
         # replays, deterministic-backoff wall, device losses handled,
         # partitions completed via the CPU path, and injected faults

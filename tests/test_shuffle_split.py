@@ -1,0 +1,293 @@
+"""Shuffle split engine v2 (one-sync coalescing split) tests.
+
+Bit-parity vs the v1 per-batch path and the CPU oracle across
+{hash, range, round-robin} x {int, string, array} columns, piece-count
+<= N, the B=4/N=8 dispatch-economics proof (~B+N dispatches, exactly 1
+host sync), the coalesce-cap fallback, and plan/semaphore balance.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.batch import (
+    HostBatch, device_to_host_many, host_to_device,
+)
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.exprs.base import ColumnRef, SortOrder
+from spark_rapids_tpu.kernels.layout import (
+    gather_segments_kway_run, take_head,
+)
+from spark_rapids_tpu.parallel.exchange import (
+    CpuShuffleExchangeExec, TpuShuffleExchangeExec, _sample_device_keys,
+)
+from spark_rapids_tpu.parallel.partitioning import (
+    HashPartitioning, RangePartitioning, RoundRobinPartitioning,
+)
+from spark_rapids_tpu.plan.physical import ExecContext, TpuExec
+from spark_rapids_tpu.runtime.device import DeviceRuntime
+from spark_rapids_tpu.session import TpuSparkSession
+
+NO_COLLAPSE = {"spark.rapids.sql.tpu.exchange.collapseLocal": False}
+V1_CONF = {"spark.rapids.sql.tpu.exchange.splitV2.enabled": False}
+
+
+def _mixed_pydict(rows, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "k": (T.INT, [int(x) for x in rng.randint(0, 23, rows)]),
+        "v": (T.INT, list(range(rows))),
+        "s": (T.STRING, [f"key{i % 11}" + "pad" * (i % 4)
+                         for i in range(rows)]),
+        "a": (T.ArrayType(T.INT), [[i % 3, i % 7, i % 5][: 1 + i % 3]
+                                   for i in range(rows)]),
+    }
+
+
+class _Source(TpuExec):
+    """Stub child: yields pre-staged device batches, one list per input
+    partition — gives the split tests exact control over B."""
+
+    def __init__(self, schema, parts):
+        super().__init__([], schema)
+        self._parts = parts
+
+    def partitions(self, ctx):
+        return [iter(list(p)) for p in self._parts]
+
+
+def _drive_split(partitioning, device_parts, extra_conf=None):
+    """Run TpuShuffleExchangeExec.partitions over the given device batch
+    partitions; returns (rows per target partition, split metrics)."""
+    conf = RapidsConf({"spark.rapids.sql.enabled": True, **NO_COLLAPSE,
+                       **(extra_conf or {})})
+    schema = device_parts[0][0].schema
+    ex = TpuShuffleExchangeExec(partitioning, _Source(schema, device_parts))
+    ctx = ExecContext(conf, device=DeviceRuntime.get(conf).device)
+    parts = ex.partitions(ctx)
+    rows_per_part = []
+    for gen in parts:
+        batches = list(gen)
+        rows = []
+        for hb in device_to_host_many(batches):
+            d = hb.to_pydict()
+            rows.extend(zip(*[d[f.name] for f in hb.schema.fields]))
+        rows_per_part.append([tuple(tuple(v) if isinstance(v, list) else v
+                                    for v in r) for r in rows])
+    metrics = {name: m.value
+               for name, m in ctx.metrics.get(ex.op_id, {}).items()}
+    ctx.close_deferred()
+    return rows_per_part, metrics
+
+
+def _parts_of(pydicts):
+    return [[host_to_device(HostBatch.from_pydict(d))] for d in pydicts]
+
+
+def _partitioning(kind, n):
+    if kind == "hash":
+        return HashPartitioning([ColumnRef("k", T.INT)], n)
+    if kind == "roundrobin":
+        return RoundRobinPartitioning(n)
+    p = RangePartitioning([SortOrder(ColumnRef("k", T.INT))], [0], n)
+    p.prepare([(k,) for k in range(23)])
+    return p
+
+
+@pytest.mark.parametrize("kind", ["hash", "range", "roundrobin"])
+def test_split_v2_matches_v1_mixed_columns(kind):
+    """Bit parity v2 vs v1 over int + string + array columns (incl. row
+    order WITHIN each target partition), and piece count <= N for v2."""
+    DeviceRuntime.reset()
+    try:
+        n = 4
+        pydicts = [_mixed_pydict(60, seed=i) for i in range(3)]
+        v2_rows, v2_m = _drive_split(_partitioning(kind, n),
+                                     _parts_of(pydicts))
+        v1_rows, v1_m = _drive_split(_partitioning(kind, n),
+                                     _parts_of(pydicts), V1_CONF)
+        assert v2_rows == v1_rows
+        assert sum(len(p) for p in v2_rows) == 180
+        assert v2_m["shufflePieces"] <= n
+        assert v2_m["shuffleSyncs"] == 1
+        assert v1_m["shuffleSyncs"] == 3  # one per input batch
+    finally:
+        DeviceRuntime.reset()
+
+
+@pytest.mark.parametrize("kind", ["hash", "range", "roundrobin"])
+def test_split_v2_matches_cpu_oracle(kind):
+    """End-to-end: a non-collapsed v2 exchange produces the same rows as
+    the CPU engine (and as v1) for each partitioning strategy."""
+    data = {"k": [(i * 37) % 23 for i in range(600)],
+            "v": list(range(600)),
+            "s": [f"val{i % 17}x{i % 5}" for i in range(600)]}
+
+    def make(s):
+        df = s.create_dataframe(data, num_partitions=3)
+        if kind == "hash":
+            return df.group_by("k").sum("v")
+        if kind == "range":
+            return df.order_by("s", "v")
+        return df.repartition(4)
+
+    base = {"spark.rapids.sql.enabled": True,
+            "spark.sql.shuffle.partitions": 4, **NO_COLLAPSE}
+    s2 = TpuSparkSession(RapidsConf(dict(base)))
+    got2 = make(s2).collect()
+    assert s2.last_metrics["shuffleSyncs"] >= 1  # split v2 actually ran
+    s1 = TpuSparkSession(RapidsConf(dict(base, **V1_CONF)))
+    got1 = make(s1).collect()
+    want = make(TpuSparkSession(
+        RapidsConf({"spark.rapids.sql.enabled": False}))).collect()
+    if kind == "range":  # order_by output order is the contract
+        assert got2 == want
+        assert got1 == want
+    else:
+        assert sorted(got2) == sorted(want)
+        assert sorted(got1) == sorted(want)
+
+
+def test_split_v2_dispatch_economics_b4_n8():
+    """The acceptance proof: a B=4 / N=8 shuffle split pays exactly ONE
+    host sync and B+N dispatches under v2, where v1 pays B syncs and
+    B*(1+N) dispatches with B*N pieces."""
+    DeviceRuntime.reset()
+    try:
+        B, N = 4, 8
+        # 256 rows per batch, round-robin: every batch feeds all 8 targets
+        pydicts = [{"v": (T.INT, [int(x) for x in range(256)])}
+                   for _ in range(B)]
+        v2_rows, v2_m = _drive_split(RoundRobinPartitioning(N),
+                                     _parts_of(pydicts))
+        v1_rows, v1_m = _drive_split(RoundRobinPartitioning(N),
+                                     _parts_of(pydicts), V1_CONF)
+        assert v2_rows == v1_rows  # bit-identical split output
+        assert v2_m["shuffleSyncs"] == 1
+        assert v2_m["shuffleSplitDispatches"] == B + N
+        assert v2_m["shufflePieces"] == N
+        assert v1_m["shuffleSyncs"] == B
+        assert v1_m["shuffleSplitDispatches"] == B * (1 + N)
+        assert v1_m["shufflePieces"] == B * N
+    finally:
+        DeviceRuntime.reset()
+
+
+def test_split_v2_coalesce_cap_falls_back_to_per_batch_pieces():
+    """A target partition whose coalesced size exceeds
+    splitCoalesceMaxBytes keeps per-batch pieces (spillable early), with
+    identical rows and still exactly one sync."""
+    DeviceRuntime.reset()
+    try:
+        n = 4
+        pydicts = [_mixed_pydict(50, seed=i) for i in range(3)]
+        cap1 = {"spark.rapids.sql.tpu.exchange.splitCoalesceMaxBytes": 1}
+        capped_rows, capped_m = _drive_split(
+            RoundRobinPartitioning(n), _parts_of(pydicts), cap1)
+        v2_rows, v2_m = _drive_split(RoundRobinPartitioning(n),
+                                     _parts_of(pydicts))
+        assert capped_rows == v2_rows
+        assert capped_m["shuffleSyncs"] == 1
+        assert v2_m["shufflePieces"] == n
+        assert capped_m["shufflePieces"] == 3 * n  # one piece per (batch, p)
+    finally:
+        DeviceRuntime.reset()
+
+
+def test_gather_segments_kway_live_bytes():
+    """Kernel-level live-bytes lesson (PR-3): segments gathered from a
+    take_head-truncated batch must read offsets[start..start+count], not
+    the stale dead-row bytes past num_rows."""
+    full = host_to_device(HostBatch.from_pydict({
+        "s": (T.STRING, ["aa", "bbbb", "cc", "dddddd", "e", "ff"]),
+        "a": (T.ArrayType(T.INT), [[1], [2, 3], [4, 5, 6], [7], [], [8]]),
+    }))
+    trunc = take_head(full, 4)  # num_rows=4; offsets still cover 6 rows
+    other = host_to_device(HostBatch.from_pydict({
+        "s": (T.STRING, ["xx", "yyy"]),
+        "a": (T.ArrayType(T.INT), [[9, 9], [10]]),
+    }))
+    out = gather_segments_kway_run([trunc, other], [1, 0], [3, 2],
+                                   out_capacity=8,
+                                   out_byte_caps=[64, 64])
+    got = device_to_host_many([out])[0].to_pydict()
+    assert got["s"] == ["bbbb", "cc", "dddddd", "xx", "yyy"]
+    assert got["a"] == [[2, 3], [4, 5, 6], [7], [9, 9], [10]]
+
+
+def test_range_bound_words_match_eager_path():
+    """encode_bounds_device + device_partition_ids_from_words (the
+    compiled range path) assigns every row the same pid as the eager
+    per-bound encode loop."""
+    batch = host_to_device(HostBatch.from_pydict({
+        "k": (T.INT, [5, 0, 19, 7, None, 22, 11, 3]),
+        "s": (T.STRING, ["m", "a", "z", "p", "q", "zz", "n", "b"]),
+    }))
+    p = RangePartitioning(
+        [SortOrder(ColumnRef("s", T.STRING)), SortOrder(ColumnRef("k", T.INT))],
+        [1, 0], 4)
+    p.prepare([(f"{chr(97 + i % 26)}", i) for i in range(40)])
+    eager = np.asarray(p.device_partition_ids(batch, 0))
+    words = p.encode_bounds_device()
+    assert len(words) >= 1
+    compiled = np.asarray(
+        p.device_partition_ids_from_words(batch, words))
+    live = int(batch.num_rows)
+    assert (eager[:live] == compiled[:live]).all()
+
+
+def test_cpu_split_argsort_preserves_row_order():
+    """Satellite: the argsort+np.split CPU split yields, per target, the
+    batch's matching rows in ORIGINAL order (what the old boolean-mask
+    scan produced and the compare harness relies on)."""
+    n = 4
+    hb = HostBatch.from_pydict(_mixed_pydict(80, seed=3))
+    part = HashPartitioning([ColumnRef("k", T.INT)], n)
+    ex = CpuShuffleExchangeExec(part, _Source(hb.schema, []))
+    ex.children[0].partitions = lambda ctx: [iter([hb])]
+    conf = RapidsConf({"spark.rapids.sql.enabled": False, **NO_COLLAPSE})
+    ctx = ExecContext(conf)
+    got = [list(p) for p in ex.partitions(ctx)]
+    ids = part.host_partition_ids(hb, 0)
+    for p in range(n):
+        want = [tuple(c.to_list()[r] for c in hb.columns)
+                for r in range(hb.num_rows) if ids[r] == p]
+        rows = []
+        for out_hb in got[p]:
+            cols = [c.to_list() for c in out_hb.columns]
+            rows.extend(zip(*cols))
+        assert [tuple(r) for r in rows] == want
+
+
+def test_sample_device_keys_gathers_on_device():
+    """Satellite: range sampling transfers at most `limit` rows (gathered
+    on device), and returns the same head rows the full-transfer path
+    did."""
+    batches = [[host_to_device(HostBatch.from_pydict({
+        "k": (T.INT, list(range(i * 100, i * 100 + 50))),
+        "s": (T.STRING, [f"s{j}" for j in range(50)]),
+    }))] for i in range(3)]
+    rows = _sample_device_keys(batches, [0, 1], limit=70)
+    assert len(rows) == 70
+    assert rows[0] == (0, "s0")
+    assert rows[49] == (49, "s49")
+    assert rows[50] == (100, "s0")  # second batch's head
+    all_rows = _sample_device_keys(batches, [0], limit=10_000)
+    assert len(all_rows) == 150
+
+
+def test_split_v2_semaphore_balance():
+    """Plan-verify balance on the coalesced path: after a non-collapsed
+    v2 query the TPU semaphore holds nothing (held_depth()==0) — the
+    split registered/closed every piece through the deferred-handle
+    protocol."""
+    s = TpuSparkSession(RapidsConf({
+        "spark.rapids.sql.enabled": True,
+        "spark.sql.shuffle.partitions": 4, **NO_COLLAPSE}))
+    df = s.create_dataframe(
+        {"k": [i % 9 for i in range(500)], "v": list(range(500))},
+        num_partitions=3)
+    assert len(df.group_by("k").sum("v").collect()) == 9
+    assert s.last_metrics["shuffleSyncs"] >= 1
+    assert s.runtime.semaphore.held_depth() == 0
