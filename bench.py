@@ -345,6 +345,54 @@ def _async_partitions_default() -> bool:
     return bool(PIPELINE_ASYNC_PARTITIONS.get(RapidsConf()))
 
 
+def time_spill():
+    """Spill engine microbench: pre-stage device batches (untimed), then
+    register them against a budget that forces most to spill to host and
+    drain — timed.  Registers are cheap; the wall is the D2H spill copies,
+    so bytes-spilled / wall is the engine's spill throughput.  Run twice,
+    async writer vs v1 synchronous, on identical inputs: the async win is
+    the writer pool overlapping copies that v1 serialized inside the
+    budget loop."""
+    from spark_rapids_tpu.batch import HostBatch, host_to_device
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.mem.catalog import BufferCatalog
+
+    from spark_rapids_tpu import types as T
+    n_batches = 8
+    rows = max(1, min(ROWS, 1 << 22) // n_batches)
+    hosts = [HostBatch.from_pydict({
+        "a": (T.LONG, (np.arange(rows, dtype=np.int64) + i).tolist()),
+        "b": (T.DOUBLE, np.full(rows, float(i)).tolist()),
+    }) for i in range(n_batches)]
+
+    def one(async_enabled):
+        devices = [host_to_device(hb) for hb in hosts]
+        for d in devices:
+            for c in d.columns:
+                c.data.block_until_ready()
+        cat = BufferCatalog(RapidsConf({
+            # every register past the first must evict its predecessor
+            "spark.rapids.memory.tpu.spillBudgetBytes": 1,
+            "spark.rapids.memory.host.spillStorageSize": 1 << 40,
+            "spark.rapids.sql.tpu.spill.async.enabled": async_enabled,
+        }))
+        t0 = time.perf_counter()
+        handles = [cat.register(d) for d in devices]
+        cat.drain_spills()
+        wall = time.perf_counter() - t0
+        spilled = cat.metrics["spill_to_host_bytes"]
+        depth = cat.metrics["spill_queue_depth_max"]
+        for h in handles:
+            h.close()
+        gbps = round(spilled / wall / 1e9, 3) if wall > 0 else 0.0
+        return gbps, depth
+
+    async_gbps, depth = one(True)
+    sync_gbps, _ = one(False)
+    speedup = round(async_gbps / sync_gbps, 3) if sync_gbps else 0.0
+    return async_gbps, sync_gbps, speedup, depth
+
+
 def main():
     try:
         platform = wait_for_backend()
@@ -387,6 +435,7 @@ def main():
     scan_tpu = time_scan_engine(True, scan_dir)
     scan_cpu = time_scan_engine(False, scan_dir)
     shuffle_gbps, shuffle_dispatches, shuffle_syncs = time_shuffle()
+    spill_gbps, spill_sync_gbps, spill_speedup, spill_depth = time_spill()
 
     data_bytes = ROWS * _bytes_per_row(data)
     device_s = tpu_econ["device_ms"] / 1e3
@@ -423,6 +472,13 @@ def main():
         "shuffle_split_dispatches": shuffle_dispatches,
         "shuffle_syncs": shuffle_syncs,
         "async_partitions": _async_partitions_default(),
+        # spill engine v2 economics (catalog microbench): async-writer
+        # spill throughput, the v1 synchronous throughput on the same
+        # batches, their ratio, and the deepest the writer queue got
+        "spill_gb_per_sec": spill_gbps,
+        "spill_sync_gb_per_sec": spill_sync_gbps,
+        "spill_async_speedup": spill_speedup,
+        "spill_queue_depth_max": spill_depth,
         # fault-tolerance counters for the steady-state run (fault/)
         "retry_count": tpu_econ["retry_count"],
         "device_lost_count": tpu_econ["device_lost_count"],

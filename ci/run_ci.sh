@@ -64,13 +64,16 @@ for key in ("value", "donated_bytes", "h2d_gb_per_sec", "d2h_gb_per_sec",
             "shuffle_gb_per_sec", "shuffle_split_dispatches",
             "shuffle_syncs", "async_partitions", "dispatch_count",
             "retry_count", "device_lost_count", "partition_fallbacks",
-            "faults_injected"):
+            "faults_injected", "spill_gb_per_sec", "spill_sync_gb_per_sec",
+            "spill_async_speedup", "spill_queue_depth_max"):
     assert key in j, f"bench JSON missing {key}: {sorted(j)}"
 assert j["value"] > 0, j
+assert j["spill_gb_per_sec"] > 0, j
 print("bench smoke ok:", {k: j[k] for k in (
     "value", "donated_bytes", "h2d_gb_per_sec", "d2h_gb_per_sec",
     "shuffle_gb_per_sec", "shuffle_split_dispatches", "shuffle_syncs",
-    "async_partitions", "retry_count", "device_lost_count")})
+    "async_partitions", "retry_count", "device_lost_count",
+    "spill_gb_per_sec", "spill_sync_gb_per_sec")})
 PY
 
 echo "== fault-injection smoke: dispatch:oom@2 must spill-retry and still"
@@ -136,6 +139,74 @@ assert m["shuffleSyncs"] >= 1, m
 print("exchange fault smoke ok:", {k: m[k] for k in (
     "retryCount", "faultsInjected", "shuffleSyncs",
     "shuffleSplitDispatches", "shufflePieces")})
+PY
+
+echo "== fault-injection smoke: unspill:oom@1 under a tiny budget must"
+echo "   hit the rehydration path, retry, and still produce exact results"
+python - << 'PY'
+import numpy as np
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.runtime.device import DeviceRuntime
+from spark_rapids_tpu.session import TpuSparkSession
+
+def make(s):
+    n = 20000
+    rng = np.random.RandomState(5)
+    left = s.create_dataframe(
+        {"k": rng.randint(0, 500, n).tolist(),
+         "v": rng.randint(0, 100, n).tolist()}, num_partitions=3)
+    right = s.create_dataframe(
+        {"k": list(range(500)), "w": list(range(500))}, num_partitions=2)
+    return left.join(right, on="k", how="inner")
+
+BASE = {
+    "spark.rapids.sql.enabled": True,
+    "spark.sql.shuffle.partitions": 4,
+    "spark.rapids.sql.tpu.exchange.collapseLocal": False,
+    "spark.sql.autoBroadcastJoinThreshold": -1,
+}
+DeviceRuntime.reset()
+try:
+    clean = TpuSparkSession(RapidsConf(BASE))
+    want = sorted(map(str, make(clean).collect()))
+    DeviceRuntime.reset()
+    s = TpuSparkSession(RapidsConf({
+        **BASE,
+        # ~64KB budget: shuffle pieces spill, so their reads must unspill
+        "spark.rapids.memory.tpu.spillBudgetBytes": 65536,
+        "spark.rapids.sql.tpu.faults.spec": "unspill:oom@1",
+    }))
+    got = sorted(map(str, make(s).collect()))
+    assert got == want, f"faulted run diverged:\n{got[:5]}\n{want[:5]}"
+    m = s.last_metrics
+    assert m["faultsInjected"] >= 1, m
+    assert m["retryCount"] > 0, m
+    mem = m.get("memory", {})
+    assert mem.get("unspilled", 0) > 0, mem
+    print("unspill fault smoke ok:", {k: m[k] for k in (
+        "retryCount", "faultsInjected", "unspillPrefetchHits")},
+        {k: mem.get(k, 0) for k in ("spilled_to_host", "unspilled")})
+finally:
+    DeviceRuntime.reset()
+PY
+
+echo "== oocore smoke: q1 under a 2MB budget, async writer on AND off,"
+echo "   both bit-correct with spills recorded"
+python - << 'PY'
+import tempfile, os
+from spark_rapids_tpu.benchmarks import oocore_run
+
+for async_on in (True, False):
+    out = os.path.join(tempfile.mkdtemp(), "oocore.md")
+    res = oocore_run.run(
+        sf=0.2, budget_mb=2, queries=["q1"], out_path=out,
+        extra_conf={"spark.rapids.sql.tpu.spill.async.enabled": async_on})
+    r = res["q1"]
+    assert r["agree"], (async_on, r)
+    assert r["spilled_to_host"] + r["spilled_to_disk"] > 0, (async_on, r)
+    print(f"oocore q1 async={async_on}: tpu {r['tpu_s']}s "
+          f"spills {r['spilled_to_host']}/{r['spilled_to_disk']} "
+          f"unspilled {r['unspilled']}")
 PY
 
 echo "== single-chip entry compile check"

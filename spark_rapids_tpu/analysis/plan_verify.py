@@ -1,7 +1,7 @@
 """Runtime-free plan-invariant verifier.
 
 Checks structural invariants of an already-built physical plan — no
-dispatch, no device work, no re-execution.  Three families:
+dispatch, no device work, no re-execution.  Four families:
 
 * **Schema consistency** — every operator's output schema is well formed
   (unique names, concrete dtypes) and the planner-inserted transitions
@@ -18,6 +18,10 @@ dispatch, no device work, no re-execution.  Three families:
 * **Semaphore balance** — after a query completes, the task-wide
   re-entrant hold depth must be back to zero; a leaked permit silently
   halves device admission for every later query in the process.
+* **Catalog accounting** — the spill catalog's incremental per-tier byte
+  counters must match a full handle scan after in-flight spills drain; a
+  mismatch means some tier transition skipped its counter update and the
+  budget loop is steering on a stale number.
 
 The module imports no engine code at import time so `tools/rapidslint.py`
 and other host-only tooling can load it without pulling in jax; the
@@ -148,6 +152,20 @@ def check_donation_provenance(root) -> List[str]:
     return problems
 
 
+def check_catalog_accounting(runtime) -> List[str]:
+    """The spill catalog's incremental per-tier byte counters must equal a
+    full handle scan (mem/catalog.py ``verify_accounting``): every tier
+    transition updates tier and counter under the same lock, so any
+    divergence means a transition path forgot its counter half.  In-flight
+    async spills are drained first — the invariant is defined at
+    lock-quiesced instants."""
+    catalog = getattr(runtime, "catalog", None)
+    if catalog is None or not hasattr(catalog, "verify_accounting"):
+        return []
+    catalog.drain_spills()
+    return list(catalog.verify_accounting())
+
+
 def check_semaphore_balance(runtime) -> List[str]:
     """Post-query the task-wide hold depth must be zero."""
     sem = getattr(runtime, "semaphore", None)
@@ -168,6 +186,7 @@ def verify_plan(root, runtime=None) -> None:
     problems += check_donation_provenance(root)
     if runtime is not None:
         problems += check_semaphore_balance(runtime)
+        problems += check_catalog_accounting(runtime)
     if problems:
         raise PlanInvariantError(problems)
 

@@ -461,6 +461,21 @@ def device_to_host(batch: ColumnBatch) -> HostBatch:
     return device_to_host_many([batch])[0]
 
 
+def host_batch_bytes(hb: HostBatch) -> int:
+    """Host bytes a :class:`HostBatch` occupies (spill-catalog host-tier
+    accounting).  Computed ONCE per tier transition and cached on the
+    handle — string columns hold python objects, so sizing them walks
+    every value and must never sit on a per-call budget path."""
+    total = 0
+    for c in hb.columns:
+        if c.dtype.is_string:
+            total += sum(len(str(x)) for x in c.values) + len(c.values)
+        else:
+            total += c.values.nbytes
+        total += c.validity.nbytes
+    return total
+
+
 def host_sizes(batches: Sequence[ColumnBatch]) -> List[Tuple[int, List[int]]]:
     """Fetch (num_rows, [string byte totals...]) for many batches in ONE
     blocking transfer (one round trip instead of one per scalar).

@@ -27,7 +27,7 @@ from spark_rapids_tpu.benchmarks.sf1_run import (
 )
 
 
-def _session(tpu: bool, root: str, budget_bytes: int):
+def _session(tpu: bool, root: str, budget_bytes: int, extra_conf=None):
     from spark_rapids_tpu.config import RapidsConf
     from spark_rapids_tpu.session import TpuSparkSession
     conf = {
@@ -37,6 +37,7 @@ def _session(tpu: bool, root: str, budget_bytes: int):
     }
     if tpu:
         conf["spark.rapids.memory.tpu.spillBudgetBytes"] = budget_bytes
+        conf.update(extra_conf or {})
     s = TpuSparkSession(RapidsConf(conf))
     for name in ("lineitem", "orders", "customer", "supplier", "nation",
                  "part", "partsupp", "region"):
@@ -50,7 +51,11 @@ def _session(tpu: bool, root: str, budget_bytes: int):
     return s
 
 
-def run(sf: float, budget_mb: int, queries, out_path: str) -> dict:
+def run(sf: float, budget_mb: int, queries, out_path: str,
+        extra_conf=None) -> dict:
+    """``extra_conf`` overlays the TPU session's conf — e.g.
+    ``{"spark.rapids.sql.tpu.spill.async.enabled": False}`` to compare the
+    async writer against the v1 synchronous spill on the same workload."""
     from spark_rapids_tpu.runtime.device import DeviceRuntime
 
     # DeviceRuntime is a process singleton: without a reset the catalog
@@ -61,12 +66,13 @@ def run(sf: float, budget_mb: int, queries, out_path: str) -> dict:
     # leaks into later sessions/tests.
     DeviceRuntime.reset()
     try:
-        return _run_inner(sf, budget_mb, queries, out_path)
+        return _run_inner(sf, budget_mb, queries, out_path, extra_conf)
     finally:
         DeviceRuntime.reset()
 
 
-def _run_inner(sf: float, budget_mb: int, queries, out_path: str) -> dict:
+def _run_inner(sf: float, budget_mb: int, queries, out_path: str,
+               extra_conf=None) -> dict:
     from spark_rapids_tpu.benchmarks.tpch_like import QUERIES
     from spark_rapids_tpu.runtime.device import DeviceRuntime
 
@@ -76,7 +82,7 @@ def _run_inner(sf: float, budget_mb: int, queries, out_path: str) -> dict:
     # DeviceRuntime singleton with a default budget — reset AFTER it so
     # the tiny-budget session below actually constructs the catalog
     DeviceRuntime.reset()
-    tpu = _session(True, root, budget)
+    tpu = _session(True, root, budget, extra_conf)
     assert tpu.runtime.catalog.device_budget == budget, \
         "spill budget did not bind (stale DeviceRuntime singleton?)"
     cpu = _session(False, root, budget)
@@ -146,8 +152,13 @@ def main(argv=None) -> int:
     ap.add_argument("--budget-mb", type=int, default=256)
     ap.add_argument("--queries", default="q1,q18")
     ap.add_argument("--out", default="BENCH_OOCORE.md")
+    ap.add_argument("--sync-spill", action="store_true",
+                    help="disable the async spill writer (v1 semantics)")
     a = ap.parse_args(argv)
-    res = run(a.sf, a.budget_mb, a.queries.split(","), a.out)
+    extra = {"spark.rapids.sql.tpu.spill.async.enabled": False} \
+        if a.sync_spill else None
+    res = run(a.sf, a.budget_mb, a.queries.split(","), a.out,
+              extra_conf=extra)
     print(json.dumps({"sf": a.sf, "budget_mb": a.budget_mb,
                       "results": res}))
     return 0

@@ -423,10 +423,33 @@ FAULTS_SPEC = conf_str(
     "spark.rapids.sql.tpu.faults.spec", "",
     "Deterministic fault injection spec, e.g. "
     "\"dispatch:oom@3;d2h:device_lost@1;spill:slow=200ms@2\": at each "
-    "named site (dispatch, h2d, d2h, spill, exchange) the Nth call "
-    "raises the named error class (or stalls, for slow=<dur>); @N+ "
+    "named site (dispatch, h2d, d2h, spill, unspill, exchange) the Nth "
+    "call raises the named error class (or stalls, for slow=<dur>); @N+ "
     "fires from the Nth call onward.  Call counters reset per query.  "
     "Empty disables injection.")
+SPILL_ASYNC_ENABLED = conf_bool(
+    "spark.rapids.sql.tpu.spill.async.enabled", True,
+    "Run budget-triggered spills on a bounded background writer pool: "
+    "reserve() transitions victims to the SPILLING tier under the "
+    "catalog lock and returns immediately; the D2H copy and any "
+    "compress+disk write overlap compute.  A get() racing an unstarted "
+    "spill cancels it cheaply; one racing a started spill joins just "
+    "that handle's completion.  false restores the v1 synchronous "
+    "spill (every tier move completes before the triggering call "
+    "returns).  OOM-triggered spills (run_with_oom_retry) are always "
+    "synchronous — eager, but off the catalog lock.")
+SPILL_WRITER_THREADS = conf_int(
+    "spark.rapids.sql.tpu.spill.writer.threads", 2,
+    "Background writer threads draining the async spill queue "
+    "(spill.async.enabled).  Each thread performs the D2H copy and the "
+    "host-budget compress+write for one victim at a time.")
+SPILL_CHUNK_BYTES = conf_bytes(
+    "spark.rapids.sql.tpu.spill.chunkBytes", 8 << 20,
+    "Frame size for disk spill files: the serialized batch streams "
+    "through the compression codec in chunks of this many bytes, so "
+    "compression overlaps the file write and unspill starts "
+    "decompressing before the whole file is read.  <=0 writes one "
+    "whole-batch frame.")
 TASK_MAX_FAILURES = conf_int(
     "spark.rapids.task.maxFailures", 0,
     "Legacy cap on partition replay attempts, honored only when set "

@@ -9,8 +9,9 @@ device batches are staged host-side before the wire/disk anyway.
 
 from __future__ import annotations
 
+import struct
 import zlib
-from typing import Callable, Dict, Tuple
+from typing import BinaryIO, Callable, Dict, Tuple
 
 
 class Codec:
@@ -90,3 +91,56 @@ def get_codec(name: str) -> Codec:
 
 def register_codec(name: str, factory: Callable[[], Codec]):
     _CODECS[name.lower()] = factory
+
+
+# ---------------------------------------------------------------------------
+# Chunked disk frames (spill engine v2)
+#
+# A spill file is a sequence of independently-compressed frames instead of
+# one whole-batch blob, so the writer's compression overlaps the file write
+# and unspill decompresses frame i while frame i+1 is still being read:
+#
+#     header:   "<QQ"  total_raw_len, frame_count
+#     frame i:  "<QQ"  raw_len, enc_len   followed by enc_len codec bytes
+#
+# chunk_bytes <= 0 degenerates to a single whole-batch frame (the v1 blob
+# shape, still wearing the frame header so the reader is uniform).
+# ---------------------------------------------------------------------------
+
+_FRAME_HEADER = struct.Struct("<QQ")
+
+
+def write_chunked(f: BinaryIO, data: bytes, codec: Codec,
+                  chunk_bytes: int) -> int:
+    """Stream ``data`` through ``codec`` into ``f`` in fixed-size frames;
+    returns the encoded byte count (frame payloads, headers excluded)."""
+    step = max(1, len(data)) if chunk_bytes <= 0 else max(1, int(chunk_bytes))
+    n = max(1, -(-len(data) // step)) if data else 1
+    f.write(_FRAME_HEADER.pack(len(data), n))
+    enc_total = 0
+    for off in range(0, len(data) or 1, step):
+        raw = data[off:off + step]
+        enc = codec.compress(raw)
+        f.write(_FRAME_HEADER.pack(len(raw), len(enc)))
+        f.write(enc)
+        enc_total += len(enc)
+    return enc_total
+
+
+def read_chunked(f: BinaryIO, codec: Codec) -> bytes:
+    """Reverse of :func:`write_chunked`: decompress frame-by-frame (frame i
+    decodes while the file position advances to frame i+1)."""
+    total_raw, n = _FRAME_HEADER.unpack(f.read(_FRAME_HEADER.size))
+    parts = []
+    got = 0
+    for _ in range(n):
+        raw_len, enc_len = _FRAME_HEADER.unpack(f.read(_FRAME_HEADER.size))
+        enc = f.read(enc_len)
+        if len(enc) != enc_len:
+            raise ValueError("truncated spill frame")
+        parts.append(codec.decompress(enc, raw_len))
+        got += raw_len
+    if got != total_raw:
+        raise ValueError(
+            f"spill frame total {got} != header raw length {total_raw}")
+    return b"".join(parts)

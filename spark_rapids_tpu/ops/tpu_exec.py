@@ -1318,12 +1318,11 @@ class TpuCachedScanExec(TpuExec):
     def partitions(self, ctx):
         if not self.holder.is_materialized:
             self._materialize(ctx)
-
-        def gen(handles):
-            for h in handles:
-                yield h.get()
-
-        return [gen(p) for p in self.holder.partitions]
+        # overlapped unspill: under memory pressure the cached handles sit
+        # on host/disk, and the drive loop keeps the next rehydration in
+        # flight while the consumer computes on the current batch
+        from spark_rapids_tpu.plan.physical import prefetch_spillables
+        return [prefetch_spillables(p) for p in self.holder.partitions]
 
 
 class TpuBroadcastHashJoinExec(TpuExec):

@@ -488,14 +488,10 @@ class TpuShuffleExchangeExec(TpuExec):
         # piece i+1's unspill (an async H2D enqueue) is already in flight,
         # so the consumer's compute overlaps the next transfer.  Handles
         # stay registered (spillable + retry-reusable) until the query
-        # closes them
-        if not handles:
-            return
-        nxt = handles[0].get()
-        for i in range(len(handles)):
-            cur = nxt
-            nxt = handles[i + 1].get() if i + 1 < len(handles) else None
-            yield cur
+        # closes them.  The overlap loop itself lives on the catalog
+        # (prefetch) — shared with the cached-scan drive path.
+        from spark_rapids_tpu.plan.physical import prefetch_spillables
+        return prefetch_spillables(handles)
 
 
 def _mesh_partitioning(p: Partitioning, n: int) -> Partitioning:

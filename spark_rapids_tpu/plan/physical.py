@@ -91,6 +91,20 @@ def _release_admission(ctx: ExecContext, n: int = 1) -> None:
     ctx._pipeline_h2d = max(0, getattr(ctx, "_pipeline_h2d", 0) - n)
 
 
+def prefetch_spillables(handles, depth: int = 1):
+    """Drive a list of SpillableBatch handles with overlapped unspill:
+    batch i+1's rehydration (disk read + decompress + async H2D enqueue)
+    is already in flight while the consumer computes on batch i
+    (catalog.prefetch).  The shared drive loop for cached-scan partitions
+    and shuffle piece reads.  Admission is NOT acquired here: the calling
+    task's semaphore permit is task-wide re-entrant and the catalog's
+    reserve() bounds device bytes, so read-ahead adds no leakable depth."""
+    handles = list(handles)
+    if not handles:
+        return iter(())
+    return handles[0]._catalog.prefetch(handles, depth=depth)
+
+
 class PhysicalOp:
     """Base physical operator."""
 

@@ -182,6 +182,8 @@ class TpuSparkSession:
         self.last_exec_ctx = ctx
         before = CR.snapshot()
         fm_before = FM.snapshot()
+        cat_before = dict(self.runtime.catalog.metrics) \
+            if self.runtime is not None else {}
         try:
             out = collect_host(phys, ctx)
         finally:
@@ -237,6 +239,25 @@ class TpuSparkSession:
         self.last_metrics["partitionFallbackCount"] = \
             fm_d["partition_fallbacks"]
         self.last_metrics["faultsInjected"] = fm_d["faults_injected"]
+        # spill-engine economics for THIS query (catalog counters are
+        # process-cumulative, so delta against the pre-query snapshot):
+        # writer wall, peak writer-queue depth, read-aheads that hid an
+        # unspill, and the bytes each tier hop moved
+        cat_now = dict(self.runtime.catalog.metrics) \
+            if self.runtime is not None else {}
+
+        def cat_delta(key):
+            return cat_now.get(key, 0) - cat_before.get(key, 0)
+
+        self.last_metrics["spillWallNs"] = cat_delta("spill_wall_ns")
+        self.last_metrics["spillQueueDepthMax"] = \
+            cat_now.get("spill_queue_depth_max", 0)
+        self.last_metrics["unspillPrefetchHits"] = \
+            cat_delta("unspill_prefetch_hits")
+        self.last_metrics["spillToHostBytes"] = cat_delta(
+            "spill_to_host_bytes")
+        self.last_metrics["spillToDiskBytes"] = cat_delta(
+            "spill_to_disk_bytes")
         if self.runtime is not None:
             self.last_metrics["memory"] = dict(self.runtime.catalog.metrics)
         return out

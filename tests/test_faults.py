@@ -176,7 +176,9 @@ def test_exchange_site_recovers_split_path():
 
 def test_spill_site_injection():
     """The catalog's spill-to-host path is instrumented: a slow fault
-    stalls it, an injected OOM surfaces from the registering call."""
+    stalls it, an injected OOM surfaces from the registering call.
+    Synchronous mode pins the v1 contract (the async-writer surfacing of
+    the same faults is covered in test_spill_async.py)."""
     from spark_rapids_tpu.batch import HostBatch, host_to_device
     from spark_rapids_tpu.mem.catalog import BufferCatalog
 
@@ -185,7 +187,8 @@ def test_spill_site_injection():
             {"x": (__import__("spark_rapids_tpu.types", fromlist=["INT"])
                    .INT, list(range(64)))}))
 
-    conf = RapidsConf({"spark.rapids.memory.tpu.spillBudgetBytes": 64})
+    conf = RapidsConf({"spark.rapids.memory.tpu.spillBudgetBytes": 64,
+                       "spark.rapids.sql.tpu.spill.async.enabled": False})
     inject.install("spill:oom@1")
     cat = BufferCatalog(conf)
     cat.register(batch(), priority=1)

@@ -45,6 +45,7 @@ def test_spill_to_host_on_budget():
     cat = make_catalog(device_budget=50)  # tiny: forces spill
     h1 = cat.register(batch(), priority=1)
     h2 = cat.register(batch(), priority=2)
+    cat.drain_spills()  # register returns with the spill still in flight
     # lowest priority spilled first
     assert h1.tier == SpillableBatch.TIER_HOST
     assert cat.metrics["spilled_to_host"] >= 1
@@ -57,6 +58,7 @@ def test_spill_to_disk_when_host_full():
     cat = make_catalog(device_budget=1, host_budget=1)
     h1 = cat.register(batch(), priority=1)
     cat.register(batch(), priority=2)
+    cat.drain_spills()
     assert cat.metrics["spilled_to_disk"] >= 1
     got = device_to_host(h1.get()).to_pydict()
     assert_batches_equal(HostBatch.from_pydict(DATA).to_pydict(), got)

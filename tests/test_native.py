@@ -130,6 +130,7 @@ class TestNativeLZ:
                           priority=1)
         cat.register(host_to_device(HostBatch.from_pydict(data)),
                      priority=2)
+        cat.drain_spills()
         assert cat.metrics["spilled_to_disk"] >= 1
         got = device_to_host(h1.get()).to_pydict()
         assert_batches_equal(HostBatch.from_pydict(data).to_pydict(), got)

@@ -1,0 +1,293 @@
+"""Spill engine v2 tests: async writer semantics, get-vs-spill races,
+writer-thread fault surfacing, incremental-accounting invariants, chunked
+disk frames, overlapped unspill (the async twin of test_mem.py's tier
+mechanics; test_faults.py::test_spill_site_injection pins the synchronous
+contract)."""
+
+import io
+import time
+
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.batch import HostBatch, device_to_host, host_to_device
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.fault import inject
+from spark_rapids_tpu.fault.inject import InjectedFault
+from spark_rapids_tpu.mem.catalog import BufferCatalog, SpillableBatch
+
+from conftest import assert_batches_equal
+
+DATA = {
+    "x": (T.INT, [1, 2, 3, None, 5]),
+    "d": (T.DOUBLE, [0.5, None, -1.25, 3.0, 2.75]),
+    "s": (T.STRING, ["aa", None, "cc", "dd", ""]),
+}
+
+# array columns spill device<->host (the disk serializer predates arrays)
+ARR_DATA = {
+    "x": (T.INT, [1, 2, 3, None, 5]),
+    "a": (T.ArrayType(T.LONG), [[1, 2], None, [], [3], [4, 5, 6]]),
+}
+
+
+def make_catalog(device_budget, host_budget=1 << 20, **extra):
+    conf = RapidsConf({
+        "spark.rapids.memory.tpu.spillBudgetBytes": device_budget,
+        "spark.rapids.memory.host.spillStorageSize": host_budget,
+        **extra,
+    })
+    return BufferCatalog(conf)
+
+
+def batch():
+    return host_to_device(HostBatch.from_pydict(DATA))
+
+
+@pytest.fixture(autouse=True)
+def _no_faults():
+    yield
+    inject.uninstall()
+
+
+@pytest.mark.parametrize("async_enabled", [True, False])
+def test_full_tier_cycle_bit_parity(async_enabled, tmp_path):
+    """device -> host -> chunked disk -> device round trip is bit-identical
+    for int/double/string/array columns, async and sync alike (tiny
+    chunkBytes forces many frames per spill file)."""
+    cat = make_catalog(
+        device_budget=1, host_budget=1,
+        **{"spark.rapids.sql.tpu.spill.async.enabled": async_enabled,
+           "spark.rapids.sql.tpu.spill.chunkBytes": 64,
+           "spark.rapids.shuffle.compression.codec": "zlib"})
+    h1 = cat.register(batch(), priority=1)
+    cat.register(batch(), priority=2)
+    cat.drain_spills()
+    assert h1.tier == SpillableBatch.TIER_DISK
+    assert cat.metrics["spilled_to_disk"] >= 1
+    assert cat.metrics["spill_to_disk_bytes"] > 0
+    got = device_to_host(h1.get()).to_pydict()
+    assert_batches_equal(HostBatch.from_pydict(DATA).to_pydict(), got)
+
+
+@pytest.mark.parametrize("async_enabled", [True, False])
+def test_host_tier_bit_parity_arrays(async_enabled):
+    """device -> host -> device round trip is bit-identical for array
+    columns too (the disk serializer predates arrays, so the host tier is
+    their spill ceiling)."""
+    cat = make_catalog(
+        device_budget=50,
+        **{"spark.rapids.sql.tpu.spill.async.enabled": async_enabled})
+    h1 = cat.register(host_to_device(HostBatch.from_pydict(ARR_DATA)),
+                      priority=1)
+    cat.register(host_to_device(HostBatch.from_pydict(ARR_DATA)),
+                 priority=2)
+    cat.drain_spills()
+    assert h1.tier == SpillableBatch.TIER_HOST
+    got = device_to_host(h1.get()).to_pydict()
+    assert_batches_equal(HostBatch.from_pydict(ARR_DATA).to_pydict(), got)
+
+
+def test_sync_mode_is_eager():
+    """async.enabled=false restores v1 semantics: the tier move completes
+    before the triggering register returns — no drain needed."""
+    cat = make_catalog(
+        device_budget=50,
+        **{"spark.rapids.sql.tpu.spill.async.enabled": False})
+    h1 = cat.register(batch(), priority=1)
+    cat.register(batch(), priority=2)
+    assert h1.tier == SpillableBatch.TIER_HOST
+    assert cat.metrics["spilled_to_host"] >= 1
+    assert cat.metrics["spill_to_host_bytes"] > 0
+
+
+def test_get_cancels_queued_spill():
+    """A get() racing a spill the writer has not started wins: the handle
+    stays device-resident and the spill is cancelled, not performed (one
+    writer thread pinned by a slow fault keeps the second spill queued
+    deterministically)."""
+    inject.install("spill:slow=400ms@1")
+    cat = make_catalog(
+        device_budget=1,
+        **{"spark.rapids.sql.tpu.spill.writer.threads": 1})
+    h1 = cat.register(batch(), priority=1)
+    h2 = cat.register(batch(), priority=2)   # picks h1: writer, stalled
+    cat.register(batch(), priority=3)        # picks h2: queued behind it
+    got = h2.get()                           # races the queued spill
+    assert h2.tier == SpillableBatch.TIER_DEVICE
+    assert got is not None
+    cat.drain_spills()
+    assert cat.metrics["spill_cancelled"] >= 1
+    assert h1.tier == SpillableBatch.TIER_HOST  # the stalled one finished
+
+
+def test_writer_fault_surfaces_at_get():
+    """A spill failing on the writer thread reverts the handle to the
+    device tier and surfaces the classified error ONCE at the consumer's
+    next get(); the retry then succeeds against the untouched device
+    copy."""
+    inject.install("spill:oom@1")
+    cat = make_catalog(device_budget=1)
+    h1 = cat.register(batch(), priority=1)
+    cat.register(batch(), priority=2)  # triggers h1's (failing) spill
+    cat.drain_spills()
+    assert h1.tier == SpillableBatch.TIER_DEVICE
+    with pytest.raises(InjectedFault):
+        h1.get()
+    # error consumed; the device copy never moved
+    got = device_to_host(h1.get()).to_pydict()
+    assert_batches_equal(HostBatch.from_pydict(DATA).to_pydict(), got)
+    assert cat.metrics["spilled_to_host"] == 0
+
+
+def test_unspill_site_injection():
+    """The rehydration path is instrumented: an unspill:oom surfaces from
+    get() and a bare retry succeeds (the copy is still host-resident)."""
+    cat = make_catalog(
+        device_budget=50,
+        **{"spark.rapids.sql.tpu.spill.async.enabled": False})
+    h1 = cat.register(batch(), priority=1)
+    cat.register(batch(), priority=2)
+    assert h1.tier == SpillableBatch.TIER_HOST
+    inject.install("unspill:oom@1")
+    with pytest.raises(InjectedFault):
+        h1.get()
+    assert h1.tier == SpillableBatch.TIER_HOST
+    got = device_to_host(h1.get()).to_pydict()
+    assert_batches_equal(HostBatch.from_pydict(DATA).to_pydict(), got)
+
+
+def test_counter_scan_invariant():
+    """The incremental per-tier byte counters match a full scan at every
+    quiesced point of the handle lifecycle (the plan_verify debug
+    invariant)."""
+    cat = make_catalog(device_budget=50, host_budget=1 << 20)
+    handles = [cat.register(batch(), priority=i) for i in range(4)]
+    cat.drain_spills()
+    assert cat.verify_accounting() == []
+    handles[0].get()
+    cat.drain_spills()
+    assert cat.verify_accounting() == []
+    handles[1].close()
+    assert cat.verify_accounting() == []
+    for h in handles:
+        if not h.closed:
+            h.close()
+    assert cat.verify_accounting() == []
+    assert cat.device_bytes_in_use() == 0
+    assert cat.host_bytes_in_use() == 0
+
+
+def test_chunked_frame_roundtrip():
+    """Chunked disk frames reproduce the payload exactly across chunk
+    sizes (including degenerate whole-blob and empty payloads) and
+    codecs."""
+    from spark_rapids_tpu.mem.codec import (
+        get_codec, read_chunked, write_chunked,
+    )
+    payloads = [b"", b"x", b"hello world " * 1000]
+    for codec_name in ("copy", "zlib"):
+        codec = get_codec(codec_name)
+        for payload in payloads:
+            for chunk in (0, 7, 64, 1 << 20):
+                buf = io.BytesIO()
+                write_chunked(buf, payload, codec, chunk)
+                buf.seek(0)
+                assert read_chunked(buf, codec) == payload
+
+
+def test_prefetch_overlaps_unspill():
+    """catalog.prefetch yields device batches in order with read-ahead:
+    spilled handles count as prefetch hits, and results are identical to
+    a plain get() loop."""
+    cat = make_catalog(device_budget=50)
+    handles = [cat.register(batch(), priority=i) for i in range(3)]
+    cat.drain_spills()
+    spilled = sum(1 for h in handles
+                  if h.tier != SpillableBatch.TIER_DEVICE)
+    assert spilled >= 1
+    want = HostBatch.from_pydict(DATA).to_pydict()
+    n = 0
+    for db in cat.prefetch(handles):
+        assert_batches_equal(want, device_to_host(db).to_pydict())
+        n += 1
+    assert n == len(handles)
+    assert cat.metrics["unspill_prefetch_hits"] >= 1
+
+
+def test_query_prefetch_hits_and_admission_balanced():
+    """An end-to-end shuffle query under a tiny budget drives its spilled
+    pieces through the prefetch read-ahead (hits recorded), leaves the
+    admission semaphore fully released (held_depth()==0), and keeps the
+    catalog counters scan-consistent."""
+    import numpy as np
+    from spark_rapids_tpu.runtime.device import DeviceRuntime
+    from spark_rapids_tpu.session import TpuSparkSession
+
+    DeviceRuntime.reset()
+    try:
+        conf = RapidsConf({
+            "spark.rapids.sql.enabled": True,
+            "spark.sql.shuffle.partitions": 4,
+            "spark.rapids.sql.tpu.exchange.collapseLocal": False,
+            "spark.sql.autoBroadcastJoinThreshold": -1,
+            "spark.rapids.memory.tpu.spillBudgetBytes": 64 * 1024,
+        })
+        s = TpuSparkSession(conf)
+        n = 20_000
+        rng = np.random.RandomState(7)
+        left = s.create_dataframe(
+            {"k": rng.randint(0, 500, n).tolist(),
+             "v": rng.randint(0, 100, n).tolist()}, num_partitions=3)
+        right = s.create_dataframe(
+            {"k": list(range(500)), "w": list(range(500))},
+            num_partitions=2)
+        rows = left.join(right, on="k", how="inner").collect()
+        assert len(rows) == n
+        mem = s.last_metrics.get("memory", {})
+        assert mem.get("unspilled", 0) > 0, mem
+        assert mem.get("unspill_prefetch_hits", 0) > 0, mem
+        assert s.last_metrics.get("unspillPrefetchHits", 0) > 0
+        assert s.runtime.semaphore.held_depth() == 0
+        assert s.runtime.catalog.verify_accounting() == []
+    finally:
+        DeviceRuntime.reset()
+
+
+def test_async_matches_sync_query_results():
+    """The same tiny-budget join is bit-identical with the async writer on
+    and off, and the async run still records spill activity."""
+    import numpy as np
+    from spark_rapids_tpu.runtime.device import DeviceRuntime
+    from spark_rapids_tpu.session import TpuSparkSession
+
+    def run(async_enabled):
+        DeviceRuntime.reset()
+        try:
+            conf = RapidsConf({
+                "spark.rapids.sql.enabled": True,
+                "spark.sql.shuffle.partitions": 4,
+                "spark.rapids.sql.tpu.exchange.collapseLocal": False,
+                "spark.sql.autoBroadcastJoinThreshold": -1,
+                "spark.rapids.memory.tpu.spillBudgetBytes": 64 * 1024,
+                "spark.rapids.sql.tpu.spill.async.enabled": async_enabled,
+            })
+            s = TpuSparkSession(conf)
+            n = 20_000
+            rng = np.random.RandomState(5)
+            left = s.create_dataframe(
+                {"k": rng.randint(0, 500, n).tolist(),
+                 "v": rng.randint(0, 100, n).tolist()}, num_partitions=3)
+            right = s.create_dataframe(
+                {"k": list(range(500)), "w": list(range(500))},
+                num_partitions=2)
+            rows = sorted(map(str, left.join(right, on="k").collect()))
+            return rows, dict(s.last_metrics.get("memory", {}))
+        finally:
+            DeviceRuntime.reset()
+
+    rows_async, mem_async = run(True)
+    rows_sync, mem_sync = run(False)
+    assert rows_async == rows_sync
+    assert mem_async.get("spilled_to_host", 0) > 0, mem_async
+    assert mem_sync.get("spilled_to_host", 0) > 0, mem_sync
