@@ -340,6 +340,66 @@ def time_shuffle():
     return gbps, m.get("shuffleSplitDispatches", 0), m.get("shuffleSyncs", 0)
 
 
+def time_adaptive():
+    """Adaptive replanning microbench (plan/adaptive): a one-hot-key
+    shuffled join (coalescing + skew split) and an aggregate-input join
+    (runtime shuffled->broadcast switch), each run with adaptive on and
+    off on identical data.  Returns (rows/s adaptive-on, on/off speedup,
+    rows bit-identical on vs off, aqe counter dict)."""
+    from spark_rapids_tpu import functions as F
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.session import TpuSparkSession
+    from spark_rapids_tpu import types as T
+    rows = min(ROWS, 1 << 18)
+    rng = np.random.RandomState(7)
+    hot = np.where(rng.rand(rows) < 0.9, 0,
+                   rng.randint(1, 64, rows)).astype(np.int32)
+    fact = {
+        "k": (T.INT, hot.tolist()),
+        "v": (T.LONG, list(range(rows))),
+    }
+    dim = {
+        "k": (T.INT, list(range(64))),
+        "w": (T.LONG, [i * 10 for i in range(64)]),
+    }
+
+    def run(adaptive_on):
+        s = TpuSparkSession(RapidsConf({
+            "spark.rapids.sql.enabled": True,
+            "spark.rapids.sql.tpu.exchange.collapseLocal": False,
+            "spark.sql.shuffle.partitions": 8,
+            "spark.sql.autoBroadcastJoinThreshold": -1,
+            "spark.rapids.sql.tpu.adaptive.enabled": adaptive_on,
+            "spark.rapids.sql.tpu.adaptive.coalesce.targetBytes": 1 << 20,
+            "spark.rapids.sql.tpu.adaptive.skew.thresholdBytes": 1 << 16,
+        }))
+        big = s.create_dataframe(fact, num_partitions=4)
+        small = s.create_dataframe(dim, num_partitions=2)
+        q = big.join(small, on="k", how="inner")
+        q.collect()  # warmup (compile)
+        t0 = time.monotonic()
+        out = q.collect()
+        wall = time.monotonic() - t0
+        counters = {k: s.last_metrics.get(k, 0) for k in (
+            "aqeCoalescedPartitions", "aqeSkewSplits",
+            "aqeEstimateErrorPct")}
+        # the switch needs a replan-eligible shape: aggregate inputs
+        # (plan-time size unknown) and a live broadcast threshold
+        s.set_conf("spark.sql.autoBroadcastJoinThreshold", 10 << 20)
+        bq = big.group_by("k").agg(F.sum("v").alias("sv")).join(
+            small.group_by("k").agg(F.sum("w").alias("sw")), on="k")
+        bq.collect()
+        counters["aqeBroadcastSwitches"] = \
+            s.last_metrics.get("aqeBroadcastSwitches", 0)
+        return wall, sorted(out), counters
+
+    on_wall, on_rows, counters = run(True)
+    off_wall, off_rows, _off = run(False)
+    speedup = round(off_wall / on_wall, 3) if on_wall else 0.0
+    return (round(len(on_rows) / on_wall, 1) if on_wall else 0.0,
+            speedup, on_rows == off_rows, counters)
+
+
 def _async_partitions_default() -> bool:
     from spark_rapids_tpu.config import PIPELINE_ASYNC_PARTITIONS, RapidsConf
     return bool(PIPELINE_ASYNC_PARTITIONS.get(RapidsConf()))
@@ -436,6 +496,7 @@ def main():
     scan_cpu = time_scan_engine(False, scan_dir)
     shuffle_gbps, shuffle_dispatches, shuffle_syncs = time_shuffle()
     spill_gbps, spill_sync_gbps, spill_speedup, spill_depth = time_spill()
+    aqe_rps, aqe_speedup, aqe_parity, aqe_counters = time_adaptive()
 
     data_bytes = ROWS * _bytes_per_row(data)
     device_s = tpu_econ["device_ms"] / 1e3
@@ -479,6 +540,18 @@ def main():
         "spill_sync_gb_per_sec": spill_sync_gbps,
         "spill_async_speedup": spill_speedup,
         "spill_queue_depth_max": spill_depth,
+        # adaptive execution economics (plan/adaptive microbench): replan
+        # counters from a skewed join + a runtime broadcast switch, the
+        # adaptive-on/off wall ratio, and whether the two plans returned
+        # bit-identical rows
+        "aqe_rows_per_sec": aqe_rps,
+        "aqe_speedup": aqe_speedup,
+        "aqe_parity": aqe_parity,
+        "aqe_coalesced_partitions": aqe_counters["aqeCoalescedPartitions"],
+        "aqe_broadcast_switches": aqe_counters["aqeBroadcastSwitches"],
+        "aqe_skew_splits": aqe_counters["aqeSkewSplits"],
+        "aqe_estimate_error_pct": round(
+            aqe_counters["aqeEstimateErrorPct"], 3),
         # fault-tolerance counters for the steady-state run (fault/)
         "retry_count": tpu_econ["retry_count"],
         "device_lost_count": tpu_econ["device_lost_count"],

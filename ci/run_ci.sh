@@ -65,10 +65,15 @@ for key in ("value", "donated_bytes", "h2d_gb_per_sec", "d2h_gb_per_sec",
             "shuffle_syncs", "async_partitions", "dispatch_count",
             "retry_count", "device_lost_count", "partition_fallbacks",
             "faults_injected", "spill_gb_per_sec", "spill_sync_gb_per_sec",
-            "spill_async_speedup", "spill_queue_depth_max"):
+            "spill_async_speedup", "spill_queue_depth_max",
+            "aqe_rows_per_sec", "aqe_speedup", "aqe_parity",
+            "aqe_coalesced_partitions", "aqe_broadcast_switches",
+            "aqe_skew_splits", "aqe_estimate_error_pct"):
     assert key in j, f"bench JSON missing {key}: {sorted(j)}"
 assert j["value"] > 0, j
 assert j["spill_gb_per_sec"] > 0, j
+assert j["aqe_parity"] is True, j
+assert j["aqe_coalesced_partitions"] > 0, j
 print("bench smoke ok:", {k: j[k] for k in (
     "value", "donated_bytes", "h2d_gb_per_sec", "d2h_gb_per_sec",
     "shuffle_gb_per_sec", "shuffle_split_dispatches", "shuffle_syncs",
@@ -139,6 +144,73 @@ assert m["shuffleSyncs"] >= 1, m
 print("exchange fault smoke ok:", {k: m[k] for k in (
     "retryCount", "faultsInjected", "shuffleSyncs",
     "shuffleSplitDispatches", "shufflePieces")})
+PY
+
+echo "== adaptive smoke: skewed join coalesces with bit-identical rows"
+echo "   adaptive on/off, and exchange:oom@2 replays through a"
+echo "   coalesced-then-switched plan"
+python - << 'PY'
+import numpy as np
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.session import TpuSparkSession
+
+rng = np.random.RandomState(11)
+n = 20000
+FACT = {"k": np.where(rng.rand(n) < 0.9, 0,
+                      rng.randint(1, 50, n)).tolist(),
+        "v": list(range(n))}
+DIM = {"k": list(range(50)), "w": [i * 3 for i in range(50)]}
+BASE = {
+    "spark.rapids.sql.enabled": True,
+    "spark.sql.shuffle.partitions": 8,
+    "spark.rapids.sql.tpu.exchange.collapseLocal": False,
+    "spark.sql.autoBroadcastJoinThreshold": -1,
+}
+
+def skew_join(s):
+    big = s.create_dataframe(FACT, num_partitions=3)
+    dim = s.create_dataframe(DIM, num_partitions=2)
+    return sorted(map(str, big.join(dim, on="k").collect()))
+
+on = TpuSparkSession(RapidsConf(BASE))
+got_on = skew_join(on)
+off = TpuSparkSession(RapidsConf({
+    **BASE, "spark.rapids.sql.tpu.adaptive.enabled": False}))
+got_off = skew_join(off)
+assert got_on == got_off, "adaptive on/off rows diverged"
+m = on.last_metrics
+assert m["aqeCoalescedPartitions"] > 0, m
+assert off.last_metrics["aqeCoalescedPartitions"] == 0, off.last_metrics
+print("adaptive skew smoke ok:", {k: m[k] for k in (
+    "aqeCoalescedPartitions", "aqeSkewSplits", "aqeStatsBytes")})
+
+# coalesced-then-switched plan under an exchange OOM: aggregate join
+# inputs (sizes unknown at plan time) with a live broadcast threshold;
+# the @2 rule fires on the second exchange-site call mid-replan
+def replan_join(s):
+    big = s.create_dataframe(FACT, num_partitions=3) \
+        .group_by("k").sum("v")
+    dim = s.create_dataframe(DIM, num_partitions=2) \
+        .group_by("k").sum("w")
+    return sorted(map(str, big.join(dim, on="k").collect()))
+
+REPLAN = {k: v for k, v in BASE.items()
+          if k != "spark.sql.autoBroadcastJoinThreshold"}
+clean = TpuSparkSession(RapidsConf(REPLAN))
+want = replan_join(clean)
+assert clean.last_metrics["aqeBroadcastSwitches"] >= 1, clean.last_metrics
+
+s = TpuSparkSession(RapidsConf({
+    **REPLAN, "spark.rapids.sql.tpu.faults.spec": "exchange:oom@2"}))
+got = replan_join(s)
+assert got == want, f"faulted replan diverged:\n{got[:3]}\n{want[:3]}"
+m = s.last_metrics
+assert m["retryCount"] > 0, m
+assert m["faultsInjected"] >= 1, m
+assert m["aqeBroadcastSwitches"] >= 1, m
+print("adaptive fault smoke ok:", {k: m[k] for k in (
+    "retryCount", "faultsInjected", "aqeBroadcastSwitches",
+    "aqeCoalescedPartitions")})
 PY
 
 echo "== fault-injection smoke: unspill:oom@1 under a tiny budget must"

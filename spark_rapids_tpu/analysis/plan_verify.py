@@ -166,6 +166,48 @@ def check_catalog_accounting(runtime) -> List[str]:
     return list(catalog.verify_accounting())
 
 
+def check_adaptive_events(root, ctx) -> List[str]:
+    """Every replan decision the adaptive layer logged
+    (``ExecContext.adaptive_events``, written by plan/adaptive) must be
+    structurally sound: the event's op is in the executed plan, the
+    mechanism is a known one, a broadcast switch happened on a shuffled
+    hash join whose join type permits SOME broadcast side (switching an
+    illegal side would drop the outer side's unmatched rows), and a skew
+    split never ran on a full outer join (chunking would double-count
+    its build-side unmatched rows)."""
+    events = getattr(ctx, "adaptive_events", None)
+    if not events:
+        return []
+    problems = []
+    by_id = {getattr(op, "op_id", None): op for op in _walk(root)}
+    known = {"coalesce", "broadcast_switch", "skew"}
+    for op_id, mechanism in events:
+        op = by_id.get(op_id)
+        if op is None:
+            problems.append(
+                f"adaptive event ({op_id}, {mechanism}) references an op "
+                "absent from the executed plan")
+            continue
+        if mechanism not in known:
+            problems.append(
+                f"{_describe(op)}: unknown adaptive mechanism "
+                f"{mechanism!r}")
+            continue
+        how = getattr(op, "how", None)
+        if mechanism == "broadcast_switch":
+            from spark_rapids_tpu.plan.adaptive import (
+                broadcast_build_sides,
+            )
+            if how is None or not broadcast_build_sides(how):
+                problems.append(
+                    f"{_describe(op)}: broadcast switch on join type "
+                    f"{how!r} with no legal broadcast side")
+        if mechanism == "skew" and how == "full":
+            problems.append(
+                f"{_describe(op)}: skew split on a full outer join")
+    return problems
+
+
 def check_semaphore_balance(runtime) -> List[str]:
     """Post-query the task-wide hold depth must be zero."""
     sem = getattr(runtime, "semaphore", None)
@@ -178,12 +220,14 @@ def check_semaphore_balance(runtime) -> List[str]:
     return []
 
 
-def verify_plan(root, runtime=None) -> None:
+def verify_plan(root, runtime=None, ctx=None) -> None:
     """Run every check; raise :class:`PlanInvariantError` on violations."""
     problems = []
     problems += check_schemas(root)
     problems += check_boundaries(root)
     problems += check_donation_provenance(root)
+    if ctx is not None:
+        problems += check_adaptive_events(root, ctx)
     if runtime is not None:
         problems += check_semaphore_balance(runtime)
         problems += check_catalog_accounting(runtime)
@@ -194,9 +238,11 @@ def verify_plan(root, runtime=None) -> None:
 def verify_session(session) -> None:
     """Verify the most recent query a :class:`TpuSparkSession` executed.
 
-    Convenience entry point for the conftest hook: pulls the plan and
-    runtime off the session, no-op when no query ran yet."""
+    Convenience entry point for the conftest hook: pulls the plan,
+    runtime and execution context off the session, no-op when no query
+    ran yet."""
     root = getattr(session, "last_physical_plan", None)
     if root is None:
         return
-    verify_plan(root, runtime=getattr(session, "runtime", None))
+    verify_plan(root, runtime=getattr(session, "runtime", None),
+                ctx=getattr(session, "last_exec_ctx", None))

@@ -300,6 +300,27 @@ AQE_SKEW_FACTOR = conf_float(
     "exceeds this multiple of the median partition size (and the "
     "advisory target); the stream side is then joined in bounded chunks "
     "against the full build side.")
+TPU_ADAPTIVE_ENABLED = conf_bool(
+    "spark.rapids.sql.tpu.adaptive.enabled", True,
+    "Master gate for the runtime-stats replanning layer (plan/adaptive): "
+    "post-shuffle partition coalescing, the dynamic shuffled->broadcast "
+    "join switch and skew splitting all read ONLY statistics the shuffle "
+    "split already fetched (piece_rows/piece_bytes), so turning this on "
+    "adds zero host syncs.  Off forces the statically planned shapes.")
+ADAPTIVE_COALESCE_TARGET_BYTES = conf_bytes(
+    "spark.rapids.sql.tpu.adaptive.coalesce.targetBytes", 0,
+    "Byte target per coalesced post-shuffle partition for the adaptive "
+    "layer.  0 (default) inherits "
+    "spark.rapids.sql.adaptive.advisoryPartitionSizeInBytes (64MB), so "
+    "the two knobs cannot fight; set nonzero to tune the adaptive layer "
+    "independently of the legacy advisory target.")
+ADAPTIVE_SKEW_THRESHOLD_BYTES = conf_bytes(
+    "spark.rapids.sql.tpu.adaptive.skew.thresholdBytes", 0,
+    "Absolute floor a partition must also exceed (besides "
+    "skewedPartitionFactor x median) to be treated as skewed and split "
+    "back into its per-source pieces.  0 (default) inherits the adaptive "
+    "coalesce byte target, i.e. a partition under one coalesce target is "
+    "never worth splitting.")
 HASH_AGG_MXU_ENABLED = conf_bool(
     "spark.rapids.sql.agg.mxuHash.enabled", True,
     "Aggregate update batches on the MXU via slot one-hot contractions "

@@ -485,7 +485,34 @@ class DataFrame:
     crossJoin = cross_join
 
     def union(self, other: "DataFrame") -> "DataFrame":
-        return DataFrame(L.Union([self.plan, other.plan]), self.session)
+        left, right = self._union_coerce(other)
+        return DataFrame(L.Union([left, right]), self.session)
+
+    def _union_coerce(self, other: "DataFrame"):
+        """Widen numeric columns to the common type before UNION (Spark's
+        WidenSetOperationTypes): `SELECT 0 AS id` against a LONG column
+        must not fail the union schema check.  Output names come from the
+        left side, per Spark."""
+        lf = list(self.plan.schema.fields)
+        rf = list(other.plan.schema.fields)
+        if len(lf) != len(rf) or \
+                all(a.dtype == b.dtype for a, b in zip(lf, rf)):
+            return self.plan, other.plan
+        try:
+            common = [T.promote(a.dtype, b.dtype)
+                      for a, b in zip(lf, rf)]
+        except TypeError:
+            return self.plan, other.plan  # let L.Union raise its check
+
+        def recast(plan, fields):
+            from spark_rapids_tpu.exprs.cast import Cast
+            exprs = []
+            for f, lt, dt in zip(fields, lf, common):
+                ref = ColumnRef(f.name, f.dtype, f.nullable)
+                exprs.append(ref if f.dtype == dt else Cast(ref, dt))
+            return L.Project(exprs, [f.name for f in lf], plan)
+
+        return recast(self.plan, lf), recast(other.plan, rf)
 
     unionAll = union
 
@@ -652,7 +679,40 @@ class DataFrame:
         return DataFrame(L.Sort(orders, False, self.plan), self.session)
 
     def _resolve_order(self, o: SortOrder) -> SortOrder:
-        return SortOrder(self._resolve(o.child), o.ascending, o.nulls_first)
+        """Resolve a sort expression against this DataFrame's schema.  A
+        bare column name the select list renamed away falls back to its
+        alias's output column (SQL allows ORDER BY on the pre-alias
+        input name — Spark resolves sort ordering against both the
+        projection's output and its input; sorting by the alias output
+        is equivalent because the alias is a pure rename)."""
+        try:
+            return SortOrder(self._resolve(o.child), o.ascending,
+                             o.nulls_first)
+        except KeyError:
+            alias = self._order_alias_for(o.child)
+            if alias is None:
+                raise
+            return SortOrder(self._resolve(ColumnRef(alias)),
+                             o.ascending, o.nulls_first)
+
+    def _order_alias_for(self, e: Expression) -> Optional[str]:
+        """Output name of a select-list entry that is a pure rename of
+        the input column ``e`` references, when this plan is a
+        projection (possibly under distinct/limit); None otherwise."""
+        if not isinstance(e, ColumnRef):
+            return None
+        node = self.plan
+        while isinstance(node, (L.Distinct, L.Limit)):
+            node = node.children[0]
+        if not isinstance(node, L.Project):
+            return None
+        for name, pe in zip(node.names, node.exprs):
+            inner = pe
+            while isinstance(inner, Alias):
+                inner = inner.children[0]
+            if isinstance(inner, ColumnRef) and inner.column == e.column:
+                return name
+        return None
 
     def limit(self, n: int) -> "DataFrame":
         return DataFrame(L.Limit(n, self.plan), self.session)

@@ -285,62 +285,24 @@ class TpuCoalesceBatchesExec(TpuExec):
         return [gen(p) for p in self.children[0].partitions(ctx)]
 
 
+# The adaptive planning logic itself (grouping rule, skew detection,
+# stat accounting, legal broadcast sides) lives in plan/adaptive; these
+# module-level aliases keep the historical import surface of this module
+# stable (tests and tooling import the grouping rule from here).
+from spark_rapids_tpu.plan import adaptive as _adaptive  # noqa: E402
+
+_aqe_part_stats = _adaptive.part_stats
+_aqe_target_rows = _adaptive.target_rows
+_aqe_target_bytes = _adaptive.target_bytes
+_aqe_target_for = _adaptive.target_for
+_group_by_target = _adaptive.group_by_target
+_coalesce_partition_lists = _adaptive.coalesce_partition_lists
+
+
 def _aqe_enabled(ctx) -> bool:
-    from spark_rapids_tpu.config import AQE_COALESCE_ENABLED
-    return AQE_COALESCE_ENABLED.get(ctx.conf)
-
-
-def _aqe_target_rows(ctx) -> int:
-    from spark_rapids_tpu.config import AQE_TARGET_ROWS
-    return AQE_TARGET_ROWS.get(ctx.conf)
-
-
-def _aqe_target_bytes(ctx) -> int:
-    from spark_rapids_tpu.config import AQE_TARGET_BYTES
-    return AQE_TARGET_BYTES.get(ctx.conf)
-
-
-def _aqe_part_stats(child, n_parts):
-    """Shuffle-recorded per-partition sizes: (sizes, unit) preferring bytes
-    over rows (the reference coalesces by map-status BYTES — row targets are
-    an order of magnitude off for wide or string-heavy rows).  Returns
-    (None, None) when the child recorded nothing (non-exchange child)."""
-    for attr, unit in (("_last_part_bytes", "bytes"),
-                       ("_last_part_rows", "rows")):
-        v = getattr(child, attr, None)
-        if v is not None and len(v) == n_parts:
-            return v, unit
-    return None, None
-
-
-def _aqe_target_for(ctx, unit) -> int:
-    return _aqe_target_bytes(ctx) if unit == "bytes" \
-        else _aqe_target_rows(ctx)
-
-
-def _group_by_target(items: List, sizes: List[int], target: int
-                     ) -> List[List]:
-    """Group consecutive items until each group reaches target rows — the
-    ONE AQE grouping rule, shared by the shuffle reader, the aggregate
-    merge and the shuffled join (which groups (left, right) pairs)."""
-    groups, cur, cur_rows = [], [], 0
-    for it, sz in zip(items, sizes):
-        cur.append(it)
-        cur_rows += sz
-        if cur_rows >= target:
-            groups.append(cur)
-            cur, cur_rows = [], 0
-    if cur or not groups:
-        groups.append(cur)
-    return groups
-
-
-def _coalesce_partition_lists(parts: List[List[ColumnBatch]],
-                              sizes: List[int], target: int
-                              ) -> List[List[ColumnBatch]]:
-    """Group consecutive partitions until each group reaches target rows."""
-    return [[b for p in g for b in p]
-            for g in _group_by_target(parts, sizes, target)]
+    """Gate for the coalescing consumers (reader / agg merge / join pair
+    grouping): the adaptive master switch AND the legacy coalesce conf."""
+    return _adaptive.coalesce_enabled(ctx)
 
 
 class TpuCoalescedShuffleReaderExec(TpuExec):
@@ -373,9 +335,12 @@ class TpuCoalescedShuffleReaderExec(TpuExec):
         sizes, unit = _aqe_part_stats(child, len(lazy_parts))
         if sizes is not None:
             # spill-friendly path: sizes came with the shuffle (no unspill
-            # just to count rows); chain the lazy generators per group
-            groups = _group_by_target(lazy_parts, sizes,
-                                      _aqe_target_for(ctx, unit))
+            # just to count rows); chain the lazy generators per group.
+            # Skewed partitions stay ALONE (their per-source pieces stream
+            # through un-merged rather than dragging neighbors into one
+            # giant downstream task).
+            groups, _gflags = _adaptive.plan_groups(
+                ctx, self.op_id, lazy_parts, sizes, unit)
             ctx.metric(self.op_id, "coalescedTo").add(len(groups))
             return [itertools.chain(*g) for g in groups]
         parts = [list(p) for p in lazy_parts]
@@ -798,10 +763,11 @@ class TpuHashAggregateExec(TpuExec):
             if _aqe_enabled(ctx) and len(lazy_parts) > 1:
                 sizes, unit = _aqe_part_stats(child, len(lazy_parts))
                 if sizes is not None:
-                    # spill-friendly: shuffle-known sizes, lazy chaining
-                    parts = [itertools.chain(*g) for g in
-                             _group_by_target(lazy_parts, sizes,
-                                              _aqe_target_for(ctx, unit))]
+                    # spill-friendly: shuffle-known sizes, lazy chaining;
+                    # skewed partitions stay un-merged (plan/adaptive)
+                    groups, _gflags = _adaptive.plan_groups(
+                        ctx, self.op_id, lazy_parts, sizes, unit)
+                    parts = [itertools.chain(*g) for g in groups]
                 else:
                     mats = [list(p) for p in lazy_parts]
                     # one round trip for every batch's sizes across ALL
@@ -928,19 +894,20 @@ class TpuShuffledHashJoinExec(TpuExec):
     def partitions(self, ctx):
         import itertools
         lchild, rchild = self.children
+        if self.num_partitions(ctx) > 1:
+            switched = self._try_broadcast_switch(ctx)
+            if switched is not None:
+                return switched
         lparts = lchild.partitions(ctx)
         rparts = rchild.partitions(ctx)
         assert len(lparts) == len(rparts)
         skew_flags = [False] * len(lparts)
 
         if _aqe_enabled(ctx) and len(lparts) > 1:
-            bc_side = self._replan_broadcast_side(ctx, len(lparts))
-            if bc_side is not None:
-                return self._broadcast_partitions(ctx, bc_side,
-                                                  lparts, rparts)
-            # AQE pair coalescing: group co-partitioned (left, right) pairs
-            # by COMBINED size so both sides stay aligned
-            # (GpuCustomShuffleReaderExec role for joins).
+            # Pair coalescing (GpuCustomShuffleReaderExec role for joins):
+            # group co-partitioned (left, right) pairs by COMBINED size so
+            # both sides stay aligned; plan_groups keeps a skewed pair
+            # ALONE and flags it for the per-piece chunked join below.
             lsz, lunit = _aqe_part_stats(lchild, len(lparts))
             rsz, runit = _aqe_part_stats(rchild, len(rparts))
             if lsz is not None and rsz is not None and lunit == runit:
@@ -948,6 +915,7 @@ class TpuShuffledHashJoinExec(TpuExec):
                 # group's pieces unspill only when that pair is joined)
                 sizes = [a + b for a, b in zip(lsz, rsz)]
                 unit = lunit
+                record = True
             else:
                 lparts = [list(p) for p in lparts]
                 rparts = [list(p) for p in rparts]
@@ -960,27 +928,14 @@ class TpuShuffledHashJoinExec(TpuExec):
                          sum(by_id[id(b)] for b in rp)
                          for lp, rp in zip(lparts, rparts)]
                 unit = "rows"
-            target = _aqe_target_for(ctx, unit)
-            groups = _group_by_target(
-                list(zip(lparts, rparts, sizes)), sizes, target)
-            lparts = [itertools.chain(*(lp for lp, _, _ in g))
+                record = False  # these sizes cost a fetch, not free stats
+            groups, skew_flags = _adaptive.plan_groups(
+                ctx, self.op_id, list(zip(lparts, rparts)), sizes, unit,
+                record=record, detect_skew=self.how != "full")
+            lparts = [itertools.chain(*(lp for lp, _ in g))
                       for g in groups]
-            rparts = [itertools.chain(*(rp for _, rp, _ in g))
+            rparts = [itertools.chain(*(rp for _, rp in g))
                       for g in groups]
-            # Skew detection (AQE OptimizeSkewedJoin role): a RAW pair far
-            # above the median raw-pair size AND the advisory target marks
-            # its group skewed — joined in chunks rather than one giant
-            # concat+join.  (Median over raw pairs, not coalesced groups:
-            # with few groups the skewed group itself drags the median up.)
-            import statistics
-            from spark_rapids_tpu.config import AQE_SKEW_FACTOR
-            med = statistics.median(sizes) if sizes else 0
-            factor = AQE_SKEW_FACTOR.get(ctx.conf)
-            # med may be 0 (most partitions empty, one hot key): any
-            # nonzero pair above the target is then skewed
-            skew_flags = [
-                any(s > factor * med and s > target for _, _, s in g)
-                for g in groups]
 
         def gen(lp, rp, skewed):
             lbs, rbs = list(lp), list(rp)
@@ -997,55 +952,79 @@ class TpuShuffledHashJoinExec(TpuExec):
         return [gen(lp, rp, sk)
                 for lp, rp, sk in zip(lparts, rparts, skew_flags)]
 
-    def _replan_broadcast_side(self, ctx, n) -> Optional[str]:
-        """AQE runtime join replan (GpuCustomShuffleReaderExec +
-        GpuOverrides AQE prep role): when the shuffle recorded build-side
-        BYTES under spark.sql.autoBroadcastJoinThreshold, drop per-pair
-        joining and run the broadcast shape — one device-resident build,
-        each stream partition joined against it, no pair alignment."""
-        from spark_rapids_tpu.config import (
-            AQE_REPLAN_JOINS, AUTO_BROADCAST_THRESHOLD,
+    def _try_broadcast_switch(self, ctx):
+        """Dynamic broadcast switch (AQE OptimizeShuffledHashJoin +
+        GpuCustomShuffleReaderExec role): try each legal build side in
+        preference order; the FIRST whose exchange materializes under
+        spark.sql.autoBroadcastJoinThreshold actual bytes wins.  The
+        already-split shuffle pieces become the broadcast build (no
+        recompute), and when the probe side's exchange has not split yet
+        its shuffle is ELIDED entirely (bypass_partitions): no pid
+        programs, no piece gathers, no split host sync on that side.
+        Returns the broadcast-shaped partition list, or None to keep the
+        shuffled shape."""
+        from spark_rapids_tpu.parallel.exchange import (
+            TpuShuffleExchangeExec,
         )
-        if not AQE_REPLAN_JOINS.get(ctx.conf):
+        if not _adaptive.replan_joins_enabled(ctx):
             return None
-        thr = AUTO_BROADCAST_THRESHOLD.get(ctx.conf)
+        thr = _adaptive.broadcast_threshold(ctx)
         if thr < 0:
             return None
         lchild, rchild = self.children
-        cands = []
-        if self.how in ("inner", "left", "left_semi", "left_anti", "cross"):
-            rbytes = getattr(rchild, "_last_part_bytes", None)
-            if rbytes is not None and len(rbytes) == n and \
-                    sum(rbytes) <= thr:
-                cands.append(("right", sum(rbytes)))
-        if self.how in ("inner", "right", "cross"):
-            lbytes = getattr(lchild, "_last_part_bytes", None)
-            if lbytes is not None and len(lbytes) == n and \
-                    sum(lbytes) <= thr:
-                cands.append(("left", sum(lbytes)))
-        if not cands:
-            return None
-        return min(cands, key=lambda c: c[1])[0]
+        for side in _adaptive.broadcast_build_sides(self.how):
+            build = rchild if side == "right" else lchild
+            probe = lchild if side == "right" else rchild
+            bparts = build.partitions(ctx)
+            bbytes = getattr(build, "_last_part_bytes", None)
+            if bbytes is None or len(bbytes) != len(bparts) or \
+                    sum(bbytes) > thr:
+                continue
+            _adaptive.record_stats(ctx, self.op_id, bbytes, "bytes")
+            if isinstance(probe, TpuShuffleExchangeExec) and \
+                    not probe.has_materialized_split(ctx):
+                sparts = probe.bypass_partitions(ctx)
+            else:
+                # the probe already split (it was tried as a build
+                # candidate, or a shared subtree ran it): read its
+                # spillable pieces rather than re-running the upstream
+                sparts = probe.partitions(ctx)
+            return self._broadcast_partitions(ctx, side, bparts, sparts)
+        return None
 
-    def _broadcast_partitions(self, ctx, side, lparts, rparts):
+    def _broadcast_partitions(self, ctx, side, build_parts, stream_parts):
         """Execute as a broadcast join: materialize the small side once,
-        join every stream partition against it."""
-        stream_parts = lparts if side == "right" else rparts
-        build_parts = rparts if side == "right" else lparts
+        join every stream partition against it.  The build handle is
+        cached per (ctx, device generation) — a device-lost reset bumps
+        the generation, so a partition REPLAY rebuilds the broadcast from
+        lineage instead of reading a handle whose device copy died with
+        the old device (fault.recovery contract, like the exchange's
+        split cache)."""
+        import weakref
+
+        from spark_rapids_tpu.runtime.device import DeviceRuntime
         build_schema = self.children[1 if side == "right" else 0] \
             .output_schema
         stream_schema = self.children[0 if side == "right" else 1] \
             .output_schema
-        bbs = [b for p in build_parts for b in p]
-        _reserve_for(ctx, bbs)
-        bc = _concat_all(bbs, build_schema)
-        bh = None
-        if bc is not None:
-            from spark_rapids_tpu.runtime.device import DeviceRuntime
-            bh = DeviceRuntime.get(ctx.conf).catalog.register(bc)
-            ctx.defer_close(bh)
-            del bc
+        gen_now = DeviceRuntime.generation()
+        cached = getattr(self, "_switch_cache", None)
+        if cached is not None and cached[0]() is ctx and \
+                cached[1] == gen_now and cached[2] == side:
+            bh = cached[3]
+        else:
+            bbs = [b for p in build_parts for b in p]
+            _reserve_for(ctx, bbs)
+            bc = _concat_all(bbs, build_schema)
+            bh = None
+            if bc is not None:
+                bh = DeviceRuntime.get(ctx.conf).catalog.register(bc)
+                ctx.defer_close(bh)
+                del bc
+            self._switch_cache = (weakref.ref(ctx), gen_now, side, bh)
         ctx.metric(self.op_id, "replannedBroadcast").add(1)
+        ctx.metric(self.op_id, "aqeBroadcastSwitches").add(1)
+        _adaptive.note_event(ctx, self.op_id, "broadcast_switch")
 
         def gen(part):
             sbs = list(part)
@@ -1062,12 +1041,17 @@ class TpuShuffledHashJoinExec(TpuExec):
         return [gen(p) for p in stream_parts]
 
     def _join_skewed(self, ctx, lbs, rbs):
-        """Skewed-group handling (AQE OptimizeSkewedJoin role): join the
-        stream side in bounded-byte chunks against the full build side
-        instead of one giant concat+join.  Stream rows belong to exactly
-        one chunk, so outer null-padding of the stream side per chunk
-        stays correct; 'full' tracks unmatched rows on BOTH sides and is
-        never chunked (caller guards)."""
+        """Skewed-group handling (AQE OptimizeSkewedJoin role): instead
+        of one giant stream-side concat+join, the stream side is joined
+        PER SOURCE PIECE — the pieces the shuffle split already produced
+        (its non-coalesced path) — and any single piece whose bytes
+        exceed the target is further cut into row-granularity chunks, so
+        the join's pair-space allocation is bounded per dispatch even
+        when the whole skewed partition arrived as one piece.  Stream
+        rows belong to exactly one chunk, so outer null-padding of the
+        stream side per chunk stays correct; 'full' tracks unmatched
+        rows on BOTH sides and is never chunked (caller guards).  ONE
+        host round trip yields every piece's rows + varlen totals."""
         from spark_rapids_tpu.batch import (
             fixed_row_bytes, host_sizes, varlen_byte_scales,
         )
@@ -1076,35 +1060,38 @@ class TpuShuffledHashJoinExec(TpuExec):
         build = rbs if split_left else lbs
         stream_schema = self.children[0 if split_left else 1].output_schema
         build_schema = self.children[1 if split_left else 0].output_schema
-        stream_b = _concat_all(stream, stream_schema)
         build_b = _concat_all(build, build_schema)
-        if stream_b is None:
+        from spark_rapids_tpu.kernels.layout import row_slices
+        frb = fixed_row_bytes(stream_schema)
+        vscales = varlen_byte_scales(stream_schema)
+        target = max(_aqe_target_bytes(ctx), 1)
+        plan = []
+        chunks = 0
+        for piece, (rows, vtotals) in zip(
+                stream, host_sizes(stream) if stream else []):
+            if rows == 0:
+                continue
+            pbytes = rows * frb + \
+                sum(t * s for t, s in zip(vtotals, vscales))
+            n_chunks = max(1, min(rows, -(-pbytes // target)))
+            rows_per = -(-rows // n_chunks)
+            plan.append((piece, rows, rows_per))
+            chunks += -(-rows // rows_per)
+        ctx.metric(self.op_id, "skewSplitChunks").add(chunks)
+        if not plan:
+            # no live stream rows: only a build-only shape can produce
+            # output (it cannot for the non-'full' hows chunked here)
             out = self._join_pair(
-                *((stream_b, build_b) if split_left
-                  else (build_b, stream_b)))
+                *((None, build_b) if split_left else (build_b, None)))
             if out is not None:
                 yield out
             return
-        # row-granularity chunks sized to the advisory byte target: the
-        # join's pair-space allocation is bounded per chunk even when the
-        # whole skewed partition arrived as one piece.  ONE host round
-        # trip yields rows + varlen totals together.
-        total_rows, vtotals = host_sizes([stream_b])[0]
-        total_bytes = total_rows * fixed_row_bytes(stream_b.schema) + \
-            sum(t * s for t, s in
-                zip(vtotals, varlen_byte_scales(stream_b.schema)))
-        from spark_rapids_tpu.kernels.layout import row_slices
-        target = max(_aqe_target_bytes(ctx), 1)
-        n_chunks = max(1, min(max(total_rows, 1),
-                              -(-total_bytes // target)))
-        rows_per = -(-max(total_rows, 1) // n_chunks)
-        ctx.metric(self.op_id, "skewSplitChunks").add(
-            -(-total_rows // rows_per) if total_rows else 0)
-        for sb in row_slices(stream_b, total_rows, rows_per):
-            lb, rb = (sb, build_b) if split_left else (build_b, sb)
-            out = self._join_pair(lb, rb)
-            if out is not None:
-                yield out
+        for piece, rows, rows_per in plan:
+            for sb in row_slices(piece, rows, rows_per):
+                lb, rb = (sb, build_b) if split_left else (build_b, sb)
+                out = self._join_pair(lb, rb)
+                if out is not None:
+                    yield out
 
     def _join_pair(self, lb, rb) -> Optional[ColumnBatch]:
         lsch = self.children[0].output_schema

@@ -152,6 +152,54 @@ class TpuShuffleExchangeExec(TpuExec):
             return 1
         return self.partitioning.num_partitions
 
+    def _ensure_fused_map(self):
+        """Compile any absorbed map stages (filter/project) into ONE
+        program per batch; shared by the collapse-local and the adaptive
+        bypass paths, which both skip the split but must still apply the
+        absorbed stages."""
+        if self._input_fns and self._fused_map is None:
+            fns = list(self._input_fns)
+
+            def composed(b):
+                for f in fns:
+                    b = f(b)
+                return b
+
+            self._fused_map = instrumented_jit(
+                composed, label="TpuShuffleExchange:map")
+
+    def has_materialized_split(self, ctx) -> bool:
+        """True when this exchange's split already ran for ``ctx`` on the
+        LIVE device generation, i.e. ``partitions`` would re-read the
+        cached spillable pieces instead of re-splitting."""
+        from spark_rapids_tpu.runtime.device import DeviceRuntime
+        cached = getattr(self, "_split_cache", None)
+        return cached is not None and cached[0]() is ctx and \
+            cached[2] == DeviceRuntime.generation()
+
+    def bypass_partitions(self, ctx):
+        """Adaptive broadcast-switch probe elision (plan/adaptive): the
+        consumer joins every partition against a broadcast build, so
+        co-partitioning buys nothing — hand back the child's partitions
+        with any absorbed map stages applied (one fused program per
+        batch) and NO split: no pid programs, no piece gathers, no split
+        host sync, no catalog registrations.  The exchange fault site
+        still fires so injection specs aimed at exchanges cover elided
+        ones, and the mesh path is never bypassed (its all_to_all IS the
+        data movement)."""
+        from spark_rapids_tpu.fault import inject
+        inject.maybe_fire("exchange")
+        if self._mesh_active(ctx):
+            return self._mesh_partitions(ctx)
+        ctx.metric(self.op_id, "shuffleElided").add(1)
+        self._ensure_fused_map()
+
+        def gen(part):
+            for db in part:
+                yield self._fused_map(db) if self._fused_map else db
+
+        return [gen(p) for p in self.children[0].partitions(ctx)]
+
     def pipeline_inline(self, ctx, build):
         if not self._collapse_local(ctx):
             return None
@@ -288,16 +336,7 @@ class TpuShuffleExchangeExec(TpuExec):
             # one logical partition holding every input batch (with any
             # absorbed map stages applied as one fused program per batch);
             # no pid computation, no split, no sampling, no host syncs
-            if self._input_fns and self._fused_map is None:
-                fns = list(self._input_fns)
-
-                def composed(b):
-                    for f in fns:
-                        b = f(b)
-                    return b
-
-                self._fused_map = instrumented_jit(
-                    composed, label="TpuShuffleExchange:map")
+            self._ensure_fused_map()
 
             def gen():
                 for part in in_parts:
@@ -368,6 +407,15 @@ class TpuShuffleExchangeExec(TpuExec):
         ctx.metric(self.op_id, "shuffleRows").add(sum(self._last_part_rows))
         ctx.metric(self.op_id, "shuffleWallNs").add(
             _time.monotonic_ns() - t0)
+        # planner-error accounting: the static size estimate the planner
+        # used for this exchange's input (stashed by overrides) vs. the
+        # actual materialized bytes just recorded — pure host arithmetic
+        # on numbers the split's own sync fetched, no extra round trip
+        est = getattr(self, "_aqe_est_bytes", None)
+        if est is not None:
+            actual = sum(self._last_part_bytes)
+            pct = abs(est - actual) * 100.0 / max(actual, 1)
+            ctx.metric(self.op_id, "aqeEstimateErrorPct").add(pct)
         self._split_cache = (weakref.ref(ctx), out, gen)
         return [self._drain_cached(p) for p in out]
 

@@ -1,0 +1,246 @@
+"""Runtime-stats replanning (adaptive execution v1).
+
+The static planner (plan/overrides) fixes partition counts and join
+strategy from pre-execution size guesses; this module is the runtime
+loop that revisits those decisions between stage materialization and
+downstream consumption, the role Spark 3.0 AQE + spark-rapids 0.3.0's
+GpuCustomShuffleReader / join replanning play for the reference.
+
+Every decision here is driven by statistics the engine ALREADY holds on
+the host — the shuffle split's one bulk size fetch records per-piece
+``piece_rows``/``piece_bytes`` and per-partition ``_last_part_rows`` /
+``_last_part_bytes`` on the exchange (parallel/exchange._split_v2) — so
+adaptive planning adds ZERO host round trips.  Three mechanisms, one
+conf family (``spark.rapids.sql.tpu.adaptive.enabled``):
+
+* **Post-shuffle coalescing** (:func:`plan_groups`): adjacent small
+  target partitions merge until each reaches the coalesce byte target,
+  so a many-partition shuffle over a small intermediate collapses to a
+  handful of downstream tasks.  Consumers chain the grouped pieces
+  lazily — coalesced reads ride the existing k-way gather/concat
+  kernels and catalog prefetch, and stay spill-friendly because pieces
+  above ``splitCoalesceMaxBytes`` were never merged on device.
+* **Dynamic broadcast switch** (ops/tpu_exec.TpuShuffledHashJoinExec
+  ``_try_broadcast_switch``): a shuffled hash join whose build-side
+  exchange materialized under ``spark.sql.autoBroadcastJoinThreshold``
+  actual bytes replans to the broadcast shape, reusing the
+  already-materialized pieces as the build and ELIDING the probe-side
+  shuffle (the probe exchange's split never runs).  The switch decision
+  and build handle are generation-checked so a device-lost replay
+  recomputes from lineage.
+* **Skew split** (:func:`skew_flags` + the join's per-piece path): a
+  target partition far above the median is never merged with its
+  neighbors, and the skewed join streams its per-source pieces in
+  bounded chunks against the full build side instead of one giant
+  concat+join.
+
+The module imports no jax at import time; everything here is host-side
+list arithmetic over already-known integers.
+"""
+
+from __future__ import annotations
+
+import statistics
+from typing import List, Optional, Tuple
+
+
+# ---------------------------------------------------------------- gates
+
+def enabled(ctx) -> bool:
+    """Master gate for every adaptive mechanism."""
+    from spark_rapids_tpu.config import TPU_ADAPTIVE_ENABLED
+    return TPU_ADAPTIVE_ENABLED.get(ctx.conf)
+
+
+def coalesce_enabled(ctx) -> bool:
+    from spark_rapids_tpu.config import AQE_COALESCE_ENABLED
+    return enabled(ctx) and AQE_COALESCE_ENABLED.get(ctx.conf)
+
+
+def replan_joins_enabled(ctx) -> bool:
+    from spark_rapids_tpu.config import AQE_REPLAN_JOINS
+    return enabled(ctx) and AQE_REPLAN_JOINS.get(ctx.conf)
+
+
+# -------------------------------------------------------------- targets
+
+def target_rows(ctx) -> int:
+    from spark_rapids_tpu.config import AQE_TARGET_ROWS
+    return AQE_TARGET_ROWS.get(ctx.conf)
+
+
+def target_bytes(ctx) -> int:
+    """Coalesce byte target: the adaptive knob, inheriting the legacy
+    advisory target when left at 0 so the two confs cannot fight."""
+    from spark_rapids_tpu.config import (
+        ADAPTIVE_COALESCE_TARGET_BYTES, AQE_TARGET_BYTES,
+    )
+    v = ADAPTIVE_COALESCE_TARGET_BYTES.get(ctx.conf)
+    return v if v > 0 else AQE_TARGET_BYTES.get(ctx.conf)
+
+
+def target_for(ctx, unit: str) -> int:
+    return target_bytes(ctx) if unit == "bytes" else target_rows(ctx)
+
+
+def skew_factor(ctx) -> float:
+    from spark_rapids_tpu.config import AQE_SKEW_FACTOR
+    return AQE_SKEW_FACTOR.get(ctx.conf)
+
+
+def skew_floor(ctx, unit: str) -> int:
+    """Absolute size a partition must also exceed to count as skewed
+    (0-valued conf inherits the coalesce target: anything under one
+    target is never worth splitting)."""
+    from spark_rapids_tpu.config import ADAPTIVE_SKEW_THRESHOLD_BYTES
+    if unit == "bytes":
+        v = ADAPTIVE_SKEW_THRESHOLD_BYTES.get(ctx.conf)
+        return v if v > 0 else target_bytes(ctx)
+    return target_rows(ctx)
+
+
+# ---------------------------------------------------------------- stats
+
+def part_stats(child, n_parts: int
+               ) -> Tuple[Optional[List[int]], Optional[str]]:
+    """Shuffle-recorded per-partition sizes: (sizes, unit) preferring
+    bytes over rows (the reference coalesces by map-status BYTES — row
+    targets are an order of magnitude off for wide or string-heavy
+    rows).  (None, None) when the child recorded nothing."""
+    for attr, unit in (("_last_part_bytes", "bytes"),
+                       ("_last_part_rows", "rows")):
+        v = getattr(child, attr, None)
+        if v is not None and len(v) == n_parts:
+            return v, unit
+    return None, None
+
+
+def record_stats(ctx, op_id: str, sizes: List[int], unit: str) -> None:
+    """Account the host-known statistics an adaptive decision consumed
+    (aqeStatsRows/aqeStatsBytes).  These numbers were fetched by the
+    shuffle split's own bulk sync — recording them costs nothing."""
+    total = sum(sizes)
+    name = "aqeStatsBytes" if unit == "bytes" else "aqeStatsRows"
+    ctx.metric(op_id, name).add(total)
+
+
+def note_event(ctx, op_id: str, mechanism: str) -> None:
+    """Append a replan event to the context's adaptive log (consumed by
+    analysis/plan_verify.check_adaptive_events)."""
+    note = getattr(ctx, "note_adaptive", None)
+    if note is not None:
+        note(op_id, mechanism)
+
+
+# ------------------------------------------------------------- grouping
+
+def group_by_target(items: List, sizes: List[int], target: int
+                    ) -> List[List]:
+    """Group consecutive items until each group reaches the target — the
+    ONE adaptive grouping rule, shared by the shuffle reader, the
+    aggregate merge and the shuffled join (which groups (left, right)
+    pairs)."""
+    groups, cur, cur_sz = [], [], 0
+    for it, sz in zip(items, sizes):
+        cur.append(it)
+        cur_sz += sz
+        if cur_sz >= target:
+            groups.append(cur)
+            cur, cur_sz = [], 0
+    if cur or not groups:
+        groups.append(cur)
+    return groups
+
+
+def coalesce_partition_lists(parts: List[List], sizes: List[int],
+                             target: int) -> List[List]:
+    """Group consecutive partitions until each group reaches target."""
+    return [[b for p in g for b in p]
+            for g in group_by_target(parts, sizes, target)]
+
+
+def skew_flags(ctx, sizes: List[int], unit: str) -> List[bool]:
+    """Per-partition skew marks (AQE OptimizeSkewedJoin role): far above
+    the MEDIAN raw size (median over raw partitions, not coalesced
+    groups — with few groups the skewed group itself drags the median
+    up; it may be 0 when most partitions are empty and one key is hot)
+    AND above the absolute floor."""
+    if not sizes:
+        return []
+    med = statistics.median(sizes)
+    factor = skew_factor(ctx)
+    floor = skew_floor(ctx, unit)
+    return [s > factor * med and s > floor for s in sizes]
+
+
+def plan_groups(ctx, op_id: str, items: List, sizes: List[int],
+                unit: str, record: bool = True, detect_skew: bool = True
+                ) -> Tuple[List[List], List[bool]]:
+    """The coalescing planner: group adjacent small partitions to the
+    target while keeping skewed partitions ALONE (a hot partition merged
+    into a group would re-serialize the stage the split is trying to
+    parallelize).  Returns (groups, per-group skew flag) and accounts
+    the aqeCoalescedPartitions / aqeSkewSplits / aqeStats* metrics.
+
+    ``record=False`` skips the stats metrics for callers whose sizes
+    came from a fallback host fetch rather than the shuffle's own sync
+    (aqeStats* counts only zero-cost, already-known statistics).
+    ``detect_skew=False`` disables isolation for consumers that cannot
+    act on a skewed partition anyway (a full outer join must see the
+    whole pair at once)."""
+    target = target_for(ctx, unit)
+    flags = skew_flags(ctx, sizes, unit) if detect_skew \
+        else [False] * len(sizes)
+    groups: List[List] = []
+    gflags: List[bool] = []
+    cur: List = []
+    cur_sz = 0
+    for it, sz, fl in zip(items, sizes, flags):
+        if fl:
+            if cur:
+                groups.append(cur)
+                gflags.append(False)
+                cur, cur_sz = [], 0
+            groups.append([it])
+            gflags.append(True)
+            continue
+        cur.append(it)
+        cur_sz += sz
+        if cur_sz >= target:
+            groups.append(cur)
+            gflags.append(False)
+            cur, cur_sz = [], 0
+    if cur or not groups:
+        groups.append(cur)
+        gflags.append(False)
+    if record:
+        record_stats(ctx, op_id, sizes, unit)
+    merged_away = len(items) - len(groups)
+    if merged_away > 0:
+        ctx.metric(op_id, "aqeCoalescedPartitions").add(merged_away)
+        note_event(ctx, op_id, "coalesce")
+    n_skew = sum(1 for f in gflags if f)
+    if n_skew:
+        ctx.metric(op_id, "aqeSkewSplits").add(n_skew)
+        note_event(ctx, op_id, "skew")
+    return groups, gflags
+
+
+# ------------------------------------------------------ broadcast switch
+
+def broadcast_build_sides(how: str) -> List[str]:
+    """Legal build sides for a runtime shuffled->broadcast switch, in
+    preference order (right first: the planner's own bias, and probe
+    elision then skips the usually-larger left shuffle).  Broadcasting
+    the outer side's opposite would drop its unmatched rows."""
+    sides = []
+    if how in ("inner", "left", "left_semi", "left_anti", "cross"):
+        sides.append("right")
+    if how in ("inner", "right", "cross"):
+        sides.append("left")
+    return sides
+
+
+def broadcast_threshold(ctx) -> int:
+    from spark_rapids_tpu.config import AUTO_BROADCAST_THRESHOLD
+    return AUTO_BROADCAST_THRESHOLD.get(ctx.conf)

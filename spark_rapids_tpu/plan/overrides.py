@@ -467,34 +467,80 @@ class TpuOverrides:
                 string_prefix_bytes=SORT_STRING_PREFIX_BYTES.get(self.conf))
         return C.CpuSortExec(orders, key_ordinals, _to_host(child))
 
+    # Heuristic average payload per varlen cell (string bytes / array
+    # elements x element width) when actual values are not visible.
+    _VARLEN_CELL_BYTES = 24
+
+    def _field_width(self, f: T.Field) -> int:
+        """Estimated bytes per row for one output column, mirroring the
+        device layout the shuffle split accounts (batch.fixed_row_bytes):
+        data itemsize + one validity byte, varlen columns a 4-byte offset
+        entry + validity + the heuristic payload."""
+        import numpy as np
+        if f.dtype.is_string or f.dtype.is_array:
+            return 5 + self._VARLEN_CELL_BYTES
+        return int(np.dtype(f.dtype.np_dtype).itemsize) + 1
+
+    def _estimate_rows(self, node: L.LogicalPlan):
+        """Plan-output row estimate (None = unknown: aggregates, joins
+        and other cardinality-changing ops make no guess)."""
+        if isinstance(node, L.InMemoryScan):
+            return sum(hb.num_rows for hb in node.batches)
+        if isinstance(node, L.Range):
+            return max(0, -(-(node.end - node.start) // node.step))
+        if isinstance(node, L.Limit):
+            rows = self._estimate_rows(node.children[0])
+            return node.n if rows is None else min(node.n, rows)
+        if isinstance(node, L.Sample):
+            rows = self._estimate_rows(node.children[0])
+            return None if rows is None else int(rows * node.fraction)
+        if isinstance(node, (L.Project, L.Filter, L.Distinct, L.Sort,
+                             L.CachedRelation, L.BroadcastHint)):
+            return self._estimate_rows(node.children[0])
+        return None
+
     def _estimate_size(self, node: L.LogicalPlan):
-        """Rough plan-output byte estimate for broadcast decisions (the
-        role Spark statistics play for GpuBroadcastHashJoinExec planning)."""
+        """Per-column-aware plan-output byte estimate for broadcast
+        decisions (the role Spark statistics play for
+        GpuBroadcastHashJoinExec planning).  Scans with visible values
+        are measured exactly — string/array payloads counted per cell —
+        and every other estimable node multiplies its row estimate by
+        ITS OWN output schema's per-column widths, so a narrowing
+        projection over a wide scan estimates the projected width, not
+        the scan's.  The runtime compares these against actual shuffle
+        bytes (aqeEstimateErrorPct, parallel/exchange)."""
         if isinstance(node, L.BroadcastHint):
             return 0
         if isinstance(node, L.InMemoryScan):
+            import numpy as np
             total = 0
             for hb in node.batches:
                 for f, c in zip(hb.schema.fields, hb.columns):
                     if f.dtype.is_string:
-                        total += sum(len(str(x)) for x in c.values) + \
-                            4 * len(c.values)
+                        total += sum(len(str(x)) for x in c.values
+                                     if x is not None) + 5 * len(c.values)
+                    elif f.dtype.is_array:
+                        ew = int(np.dtype(
+                            f.dtype.element.np_dtype).itemsize)
+                        total += ew * sum(len(x) for x in c.values
+                                          if x is not None) + \
+                            5 * len(c.values)
                     else:
-                        total += c.values.nbytes
+                        total += c.values.nbytes + len(c.values)
             return total
-        if isinstance(node, L.Range):
-            total = max(0, -(-(node.end - node.start) // node.step))
-            return total * 8
         if isinstance(node, L.FileScan):
             import os
             try:
                 return sum(os.path.getsize(p) for p in node.paths)
             except OSError:
                 return None
-        if isinstance(node, (L.Project, L.Filter, L.Limit, L.Sample,
-                             L.Distinct, L.Sort, L.CachedRelation)):
-            return self._estimate_size(node.children[0])
-        return None
+        rows = self._estimate_rows(node)
+        if rows is None:
+            return None
+        fields = getattr(node.schema, "fields", None)
+        if not fields:
+            return None
+        return rows * sum(self._field_width(f) for f in fields)
 
     def _convert_join(self, node: L.Join, conv: List[PhysicalOp],
                       on_tpu: bool) -> PhysicalOp:
@@ -536,6 +582,13 @@ class TpuOverrides:
         if on_tpu:
             lex = TpuShuffleExchangeExec(lpart, _to_device(left))
             rex = TpuShuffleExchangeExec(rpart, _to_device(right))
+            # stash the static estimates: the exchange compares them
+            # against actual materialized bytes (aqeEstimateErrorPct) so
+            # bench runs quantify planner error
+            if l_est is not None:
+                lex._aqe_est_bytes = l_est
+            if r_est is not None:
+                rex._aqe_est_bytes = r_est
             return X.TpuShuffledHashJoinExec(
                 lex, rex, node.left_keys, node.right_keys, node.how,
                 node.condition, node.schema)

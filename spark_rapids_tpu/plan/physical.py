@@ -67,6 +67,14 @@ class ExecContext:
         # outputs survive partition retries, like the reference's shuffle
         # files); collect_host closes them when the query ends
         self._deferred_handles: List = []
+        # (op_id, mechanism) replan decisions the adaptive layer took for
+        # this query (plan/adaptive.note_event), checked post-query by
+        # analysis/plan_verify.check_adaptive_events: every event must
+        # point at a live plan op and respect join-type legality
+        self.adaptive_events: List = []
+
+    def note_adaptive(self, op_id: str, mechanism: str) -> None:
+        self.adaptive_events.append((op_id, mechanism))
 
     def defer_close(self, handle) -> None:
         self._deferred_handles.append(handle)

@@ -186,13 +186,24 @@ def _worth_shrinking(outs: List[ColumnBatch], ctx: ExecContext) -> bool:
         _shrink_threshold(ctx)
 
 
+def _record_break_stats(ctx: ExecContext, sizes) -> None:
+    """Stage-break live sizes feed the adaptive statistics pool
+    (aqeStatsRows): the sizes round trip was paid for the re-bucketing
+    anyway, so accounting the rows it revealed keeps the pipelined path
+    inside plan/adaptive's zero-extra-sync contract."""
+    ctx.metric("pipeline", "aqeStatsRows").add(
+        sum(int(n) for n, _ in sizes))
+
+
 def _shrink_spec(outs: List[ColumnBatch], ctx: ExecContext):
     """Per-batch re-bucketing spec for a stage break's raw outputs — ONE
     sizes round trip for all batches — or None when the padded total is
     too small to be worth a shrink."""
     if not _worth_shrinking(outs, ctx):
         return None
-    return _spec_of(host_sizes(outs))
+    sizes = host_sizes(outs)
+    _record_break_stats(ctx, sizes)
+    return _spec_of(sizes)
 
 
 def _apply_shrink(outs: List[ColumnBatch], spec: tuple, ctx: ExecContext,
@@ -270,7 +281,9 @@ def _materialize_sources(sources: List[PhysicalOp], ctx: ExecContext,
                     # right here, before the next source dispatches —
                     # the old sequential order the conf's off position
                     # promises to restore
-                    resolve(len(mats) - 1, _spec_of(host_sizes(outs)))
+                    src_sizes = host_sizes(outs)
+                    _record_break_stats(ctx, src_sizes)
+                    resolve(len(mats) - 1, _spec_of(src_sizes))
         else:
             batches = []
             for part in src.partitions(ctx):
@@ -285,6 +298,7 @@ def _materialize_sources(sources: List[PhysicalOp], ctx: ExecContext,
         # only after all their programs are in flight
         flat = [b for _, outs in pending for b in outs]
         sizes = host_sizes(flat)
+        _record_break_stats(ctx, sizes)
         pos = 0
         for i, outs in pending:
             resolve(i, _spec_of(sizes[pos:pos + len(outs)]))

@@ -230,6 +230,36 @@ class TpuSparkSession:
         self.last_metrics["shuffleWallNs"] = sum(
             ms["shuffleWallNs"].value for ms in ctx.metrics.values()
             if "shuffleWallNs" in ms)
+        # adaptive-execution economics (plan/adaptive), summed over every
+        # op that replanned: partitions merged away by post-shuffle
+        # coalescing, joins switched to the broadcast shape at runtime,
+        # skewed partitions isolated/split, and the volume of host-known
+        # statistics those decisions consumed (all recorded with zero
+        # extra host syncs — the shuffle split already fetched them)
+        self.last_metrics["aqeCoalescedPartitions"] = sum(
+            ms["aqeCoalescedPartitions"].value
+            for ms in ctx.metrics.values()
+            if "aqeCoalescedPartitions" in ms)
+        self.last_metrics["aqeBroadcastSwitches"] = sum(
+            ms["aqeBroadcastSwitches"].value for ms in ctx.metrics.values()
+            if "aqeBroadcastSwitches" in ms)
+        self.last_metrics["aqeSkewSplits"] = sum(
+            ms["aqeSkewSplits"].value for ms in ctx.metrics.values()
+            if "aqeSkewSplits" in ms)
+        self.last_metrics["aqeStatsRows"] = sum(
+            ms["aqeStatsRows"].value for ms in ctx.metrics.values()
+            if "aqeStatsRows" in ms)
+        self.last_metrics["aqeStatsBytes"] = sum(
+            ms["aqeStatsBytes"].value for ms in ctx.metrics.values()
+            if "aqeStatsBytes" in ms)
+        # planner size-estimate error vs. actual shuffle bytes, averaged
+        # over the exchanges that carried a static estimate (0.0 when the
+        # query had none)
+        _errs = [ms["aqeEstimateErrorPct"].value
+                 for ms in ctx.metrics.values()
+                 if "aqeEstimateErrorPct" in ms]
+        self.last_metrics["aqeEstimateErrorPct"] = \
+            sum(_errs) / len(_errs) if _errs else 0.0
         # fault-tolerance economics (fault.metrics deltas): recovery
         # replays, deterministic-backoff wall, device losses handled,
         # partitions completed via the CPU path, and injected faults

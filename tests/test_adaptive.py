@@ -1,0 +1,298 @@
+"""Adaptive execution v1 (plan/adaptive): on/off bit parity over mixed
+column types, coalescing economics, dynamic shuffled->broadcast switch,
+skew split on one-hot-key data, device-lost replay through a switched
+join, and permit balance after every adaptive query."""
+
+import math
+
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.dataframe import Column
+from spark_rapids_tpu.exprs.aggregates import Count, Sum
+from spark_rapids_tpu.exprs.base import Alias, ColumnRef
+from spark_rapids_tpu.fault import inject
+
+from compare import _canon, cpu_session, tpu_session
+
+NO_COLLAPSE = {"spark.rapids.sql.tpu.exchange.collapseLocal": False}
+ADAPTIVE_OFF = {"spark.rapids.sql.tpu.adaptive.enabled": False}
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    inject.uninstall()
+    yield
+    inject.uninstall()
+
+
+def _assert_equal_rows(a_rows, b_rows, ordered=False):
+    a = _canon(a_rows, True, not ordered)
+    b = _canon(b_rows, True, not ordered)
+    assert len(a) == len(b), f"lhs={len(a)} rhs={len(b)}"
+    for i, (ra, rb) in enumerate(zip(a, b)):
+        assert ra == rb, f"row {i}: lhs={ra} rhs={rb}"
+
+
+def _assert_balanced(s):
+    assert s.runtime.semaphore.held_depth() == 0, \
+        "leaked device admission permit"
+
+
+def _metric_ops(sess, name):
+    return [op for op, ms in sess.last_metrics.items()
+            if isinstance(ms, dict) and name in ms]
+
+
+MIXED = {
+    "k": (T.INT, [i % 7 for i in range(180)]),
+    "v": (T.LONG, list(range(180))),
+    "s": (T.STRING, [f"name{i % 13}" + "pad" * (i % 3)
+                     for i in range(180)]),
+    "a": (T.ArrayType(T.LONG), [[i % 5, i % 3][: 1 + i % 2]
+                                for i in range(180)]),
+}
+#: MIXED minus the array column: arrays force a CPU join/sort fallback
+#: (nested-type envelope), so coalescing-metric tests use this schema.
+FLAT = {k: v for k, v in MIXED.items() if k != "a"}
+DIM = {
+    "k": (T.INT, [0, 1, 2, 3, 4, 5, 6]),
+    "w": (T.LONG, [10, 20, 30, 40, 50, 60, 70]),
+}
+
+
+def _sessions(extra=None):
+    base = dict(NO_COLLAPSE, **(extra or {}))
+    return (tpu_session(**base),
+            tpu_session(**dict(base, **ADAPTIVE_OFF)),
+            cpu_session(**base))
+
+
+# -- on/off bit parity -------------------------------------------------------
+
+
+def test_adaptive_onoff_parity_repartition_mixed_types():
+    """Int + string + array columns through a real (non-collapsed)
+    varlen shuffle: adaptive on, adaptive off and the CPU engine agree
+    bit-for-bit.  (Explicit repartition(n) keeps its partition count —
+    Spark AQE likewise never coalesces a user-specified repartition —
+    so this pins that adaptive leaves the varlen split untouched.)"""
+    def q(s):
+        return s.create_dataframe(MIXED, num_partitions=3) \
+            .repartition(8, "k").collect()
+    on, off, cpu = _sessions()
+    rows_on, rows_off, rows_cpu = q(on), q(off), q(cpu)
+    _assert_equal_rows(rows_cpu, rows_on)
+    _assert_equal_rows(rows_off, rows_on)
+    _assert_balanced(on)
+    _assert_balanced(off)
+
+
+def test_adaptive_onoff_parity_coalesced_sort_strings():
+    """Global sort (RangePartitioning shuffle + coalescing reader) over
+    int + string columns: identical ordered rows with adaptive on, off
+    and on the CPU engine, and the reader provably coalesced."""
+    confs = {"spark.sql.shuffle.partitions": 8}
+
+    def q(s):
+        return s.create_dataframe(FLAT, num_partitions=3) \
+            .order_by("v").collect()
+    on, off, cpu = _sessions(confs)
+    rows_on, rows_off, rows_cpu = q(on), q(off), q(cpu)
+    _assert_equal_rows(rows_cpu, rows_on, ordered=True)
+    _assert_equal_rows(rows_off, rows_on, ordered=True)
+    _assert_balanced(on)
+    _assert_balanced(off)
+
+
+def test_adaptive_onoff_parity_agg_join():
+    """The replan-eligible shape (both join inputs aggregated) stays
+    bit-identical with adaptive fully disabled."""
+    def q(s):
+        big = s.create_dataframe(MIXED, num_partitions=3) \
+            .group_by("k").agg(Column(Alias(Sum(ColumnRef("v")), "sv")),
+                               Column(Alias(Count(ColumnRef("s")), "c")))
+        dim = s.create_dataframe(DIM, num_partitions=2) \
+            .group_by("k").agg(Column(Alias(Sum(ColumnRef("w")), "sw")))
+        return big.join(dim, on="k", how="inner").collect()
+    on, off, cpu = _sessions()
+    rows_on, rows_off, rows_cpu = q(on), q(off), q(cpu)
+    _assert_equal_rows(rows_cpu, rows_on)
+    _assert_equal_rows(rows_off, rows_on)
+    _assert_balanced(on)
+    _assert_balanced(off)
+    assert on.last_metrics.get("aqeBroadcastSwitches", 0) >= 1
+    assert off.last_metrics.get("aqeBroadcastSwitches", 0) == 0
+    # stats consumed by the replan were free: shuffle sync count identical
+    assert on.last_metrics["shuffleSyncs"] <= off.last_metrics["shuffleSyncs"]
+
+
+# -- coalescing economics ----------------------------------------------------
+
+
+def test_coalesce_group_bound_and_dispatch_drop():
+    """N shuffle partitions feed the join, at most
+    ceil(total/targetBytes) coalesced tasks come out (no skew on uniform
+    data), and the coalesced plan dispatches FEWER device programs than
+    the uncoalesced one — with identical shuffle sync counts (the stats
+    were already host-known)."""
+    target = 2048
+    n_in = 8
+    confs = {"spark.sql.shuffle.partitions": n_in,
+             "spark.sql.autoBroadcastJoinThreshold": -1,
+             "spark.rapids.sql.tpu.adaptive.coalesce.targetBytes": target}
+
+    def q(s):
+        big = s.create_dataframe(FLAT, num_partitions=3)
+        dim = s.create_dataframe(DIM, num_partitions=2)
+        return big.join(dim, on="k", how="inner").collect()
+
+    on = tpu_session(**dict(NO_COLLAPSE, **confs))
+    off = tpu_session(**dict(NO_COLLAPSE, **confs, **ADAPTIVE_OFF))
+    _assert_equal_rows(q(off), q(on))
+    _assert_balanced(on)
+    _assert_balanced(off)
+
+    joins = [op for op in _metric_ops(on, "aqeCoalescedPartitions")
+             if "aqeStatsBytes" in on.last_metrics[op]]
+    assert joins, f"join did not pair-coalesce: {on.last_metrics}"
+    ms = on.last_metrics[joins[0]]
+    n_out = n_in - ms["aqeCoalescedPartitions"]
+    total = ms["aqeStatsBytes"]
+    assert total > 0
+    assert 1 <= n_out <= math.ceil(total / target)
+
+    # fewer downstream partitions -> fewer compiled-program dispatches
+    assert on.last_metrics["dispatchCount"] < \
+        off.last_metrics["dispatchCount"], \
+        (on.last_metrics["dispatchCount"],
+         off.last_metrics["dispatchCount"])
+    # the statistics were free: both plans synced the device identically
+    assert on.last_metrics["shuffleSyncs"] == \
+        off.last_metrics["shuffleSyncs"]
+
+
+# -- dynamic broadcast switch ------------------------------------------------
+
+
+def _replan_join(s, how="inner"):
+    big = s.create_dataframe(MIXED, num_partitions=3) \
+        .group_by("k", "v").agg(Column(Alias(Count(ColumnRef("s")), "c")))
+    dim = s.create_dataframe(DIM, num_partitions=2) \
+        .group_by("k").agg(Column(Alias(Sum(ColumnRef("w")), "sw")))
+    return big.join(dim, on="k", how=how)
+
+
+def test_broadcast_switch_matches_static_broadcast_plan():
+    """The runtime-switched join returns exactly what the compile-time
+    broadcast plan (explicit hint) and the never-switched shuffled plan
+    return, and elides the probe-side shuffle split."""
+    switched = tpu_session(**NO_COLLAPSE)
+    rows_sw = _replan_join(switched).collect()
+    assert switched.last_metrics.get("aqeBroadcastSwitches", 0) >= 1, \
+        switched.last_metrics
+    assert _metric_ops(switched, "replannedBroadcast")
+    assert _metric_ops(switched, "shuffleElided"), \
+        "probe-side shuffle was not elided"
+
+    static = tpu_session(**NO_COLLAPSE)
+    big = static.create_dataframe(MIXED, num_partitions=3) \
+        .group_by("k", "v").agg(Column(Alias(Count(ColumnRef("s")), "c")))
+    dim = F.broadcast(
+        static.create_dataframe(DIM, num_partitions=2)
+        .group_by("k").agg(Column(Alias(Sum(ColumnRef("w")), "sw"))))
+    rows_static = big.join(dim, on="k", how="inner").collect()
+
+    never = tpu_session(**dict(
+        NO_COLLAPSE, **{"spark.sql.autoBroadcastJoinThreshold": -1}))
+    rows_never = _replan_join(never).collect()
+    assert never.last_metrics.get("aqeBroadcastSwitches", 0) == 0
+
+    _assert_equal_rows(rows_static, rows_sw)
+    _assert_equal_rows(rows_never, rows_sw)
+    for s in (switched, static, never):
+        _assert_balanced(s)
+
+
+def test_estimate_error_pct_recorded():
+    """A shuffled join of scans (plan-time estimates known) records how
+    far the static estimate was from the actual shuffled bytes."""
+    s = tpu_session(**dict(
+        NO_COLLAPSE, **{"spark.sql.autoBroadcastJoinThreshold": -1}))
+    big = s.create_dataframe(FLAT, num_partitions=3)
+    dim = s.create_dataframe(DIM, num_partitions=2)
+    big.join(dim, on="k", how="inner").collect()
+    assert "aqeEstimateErrorPct" in s.last_metrics
+    assert s.last_metrics["aqeEstimateErrorPct"] >= 0.0
+    assert _metric_ops(s, "aqeEstimateErrorPct"), s.last_metrics
+    _assert_balanced(s)
+
+
+# -- skew split --------------------------------------------------------------
+
+
+HOT = {
+    "k": (T.INT, [0] * 300 + [1, 2, 3]),
+    "v": (T.LONG, list(range(303))),
+}
+HOT_DIM = {
+    "k": (T.INT, [0, 1, 2, 3]),
+    "w": (T.LONG, [7, 8, 9, 10]),
+}
+
+
+def test_skew_split_parity_one_hot_key():
+    """One key holds ~99% of the rows: the hot partition is isolated,
+    chunked per-source against the full build, and the answer matches
+    both the CPU engine and the adaptive-off plan."""
+    confs = dict(NO_COLLAPSE, **{
+        "spark.sql.shuffle.partitions": 8,
+        "spark.rapids.sql.tpu.adaptive.coalesce.targetBytes": 512,
+        "spark.rapids.sql.tpu.adaptive.skew.thresholdBytes": 512,
+        "spark.sql.autoBroadcastJoinThreshold": -1,
+    })
+
+    def q(s):
+        big = s.create_dataframe(HOT, num_partitions=3)
+        dim = s.create_dataframe(HOT_DIM, num_partitions=2)
+        return big.join(dim, on="k", how="inner").collect()
+
+    on = tpu_session(**confs)
+    off = tpu_session(**dict(confs, **ADAPTIVE_OFF))
+    cpu = cpu_session(**confs)
+    rows_on, rows_off, rows_cpu = q(on), q(off), q(cpu)
+    _assert_equal_rows(rows_cpu, rows_on)
+    _assert_equal_rows(rows_off, rows_on)
+    assert on.last_metrics.get("aqeSkewSplits", 0) >= 1, on.last_metrics
+    chunk_ops = _metric_ops(on, "skewSplitChunks")
+    assert chunk_ops, on.last_metrics
+    assert sum(on.last_metrics[op]["skewSplitChunks"]
+               for op in chunk_ops) >= 2
+    assert off.last_metrics.get("aqeSkewSplits", 0) == 0
+    _assert_balanced(on)
+    _assert_balanced(off)
+
+
+# -- device-lost replay through a switched join ------------------------------
+
+
+@pytest.mark.parametrize("spec", [
+    "dispatch:device_lost@1", "exchange:device_lost@1",
+])
+def test_device_lost_replay_through_switched_join(spec):
+    """A device loss mid-query invalidates the generation-checked switch
+    cache; the replay recomputes from lineage and the switched join
+    still answers bit-identically."""
+    clean = tpu_session(**NO_COLLAPSE)
+    want = _replan_join(clean).collect()
+    assert clean.last_metrics.get("aqeBroadcastSwitches", 0) >= 1
+
+    s = tpu_session(**dict(
+        NO_COLLAPSE, **{"spark.rapids.sql.tpu.faults.spec": spec}))
+    got = _replan_join(s).collect()
+    _assert_equal_rows(want, got)
+    m = s.last_metrics
+    assert m["deviceLostCount"] >= 1, m
+    assert m.get("aqeBroadcastSwitches", 0) >= 1, m
+    _assert_balanced(s)
