@@ -180,6 +180,21 @@ def time_engine(tpu_enabled: bool, data, runs: int = 3,
         q.collect()
         device = dict(s.last_metrics)
         s.set_conf("spark.rapids.sql.tpu.metrics.detailEnabled", False)
+    obs_overhead_pct = 0.0
+    if econ_detail:
+        # obs-off timed loop over the same compiled plan (obs confs are
+        # excluded from the plan-cache fingerprint, so nothing
+        # recompiles): best-on vs best-off wall IS the event bus's cost
+        s.set_conf("spark.rapids.sql.tpu.obs.enabled", False)
+        best_off = float("inf")
+        for _ in range(runs):
+            t0 = time.monotonic()
+            q.collect()
+            best_off = min(best_off, time.monotonic() - t0)
+        s.set_conf("spark.rapids.sql.tpu.obs.enabled", True)
+        if best_off > 0 and best_off != float("inf"):
+            obs_overhead_pct = round(100.0 * (best - best_off) / best_off,
+                                     2)
     econ = {
         "compile_s": round(warm.get("compileWallNs", 0) / 1e9, 3),
         "compile_count": warm.get("compileCount", 0),
@@ -207,6 +222,11 @@ def time_engine(tpu_enabled: bool, data, runs: int = 3,
         "device_lost_count": repeat.get("deviceLostCount", 0),
         "partition_fallbacks": repeat.get("partitionFallbackCount", 0),
         "faults_injected": repeat.get("faultsInjected", 0),
+        # observability economics: events the steady-state run produced,
+        # and the wall-time cost of producing them (obs-on best vs the
+        # obs-off loop above; negative values are run-to-run noise)
+        "obs_event_count": repeat.get("obsEventCount", 0),
+        "obs_overhead_pct": obs_overhead_pct,
     }
     return best, econ
 
@@ -557,6 +577,10 @@ def main():
         "device_lost_count": tpu_econ["device_lost_count"],
         "partition_fallbacks": tpu_econ["partition_fallbacks"],
         "faults_injected": tpu_econ["faults_injected"],
+        # observability economics (obs/): steady-state event volume and
+        # the measured wall cost of the always-on event bus
+        "obs_event_count": tpu_econ["obs_event_count"],
+        "obs_overhead_pct": tpu_econ["obs_overhead_pct"],
         "platform": platform,
         "scan_rows_per_sec": round(SCAN_ROWS / scan_tpu, 1),
         "scan_vs_baseline": round(scan_cpu / scan_tpu, 3),

@@ -68,7 +68,8 @@ for key in ("value", "donated_bytes", "h2d_gb_per_sec", "d2h_gb_per_sec",
             "spill_async_speedup", "spill_queue_depth_max",
             "aqe_rows_per_sec", "aqe_speedup", "aqe_parity",
             "aqe_coalesced_partitions", "aqe_broadcast_switches",
-            "aqe_skew_splits", "aqe_estimate_error_pct"):
+            "aqe_skew_splits", "aqe_estimate_error_pct",
+            "obs_event_count", "obs_overhead_pct"):
     assert key in j, f"bench JSON missing {key}: {sorted(j)}"
 assert j["value"] > 0, j
 assert j["spill_gb_per_sec"] > 0, j
@@ -79,6 +80,46 @@ print("bench smoke ok:", {k: j[k] for k in (
     "shuffle_gb_per_sec", "shuffle_split_dispatches", "shuffle_syncs",
     "async_partitions", "retry_count", "device_lost_count",
     "spill_gb_per_sec", "spill_sync_gb_per_sec")})
+PY
+
+echo "== obs smoke: event log -> rapidsprof report + Perfetto-loadable trace"
+python - << 'PY'
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.session import TpuSparkSession
+
+log_dir = tempfile.mkdtemp(prefix="rapids_obs_smoke_")
+s = TpuSparkSession(RapidsConf({
+    "spark.rapids.sql.enabled": True,
+    "spark.rapids.sql.tpu.obs.eventLogDir": log_dir,
+}))
+df = s.create_dataframe(
+    {"k": [i % 7 for i in range(4096)], "v": list(range(4096))},
+    num_partitions=2)
+df.group_by("k").sum("v").order_by("k").collect()
+assert s.last_metrics["obsEventCount"] > 0, s.last_metrics
+assert s.query_history(), "no profile recorded"
+logs = [os.path.join(log_dir, f) for f in os.listdir(log_dir)]
+assert len(logs) == 1, logs
+
+trace = os.path.join(log_dir, "trace.json")
+out = subprocess.run(
+    [sys.executable, "tools/rapidsprof.py", logs[0], "--chrome", trace],
+    capture_output=True, text=True, timeout=300)
+assert out.returncode == 0, f"rapidsprof failed:\n{out.stderr[-2000:]}"
+assert "Exec" in out.stdout, f"report names no operator:\n{out.stdout}"
+with open(trace) as f:
+    tdoc = json.load(f)
+assert tdoc["traceEvents"], "empty Chrome trace"
+print("obs smoke ok:", {
+    "events": s.last_metrics["obsEventCount"],
+    "dropped": s.last_metrics["obsEventsDropped"],
+    "trace_events": len(tdoc["traceEvents"])})
 PY
 
 echo "== fault-injection smoke: dispatch:oom@2 must spill-retry and still"

@@ -488,6 +488,25 @@ METRICS_DETAIL = conf_bool(
     "deviceTimeNs/shuffleWallNs measure real device execution instead of "
     "async-dispatch lower bounds.  Costs a host sync per dispatch (kills "
     "async overlap) — leave off outside measurement runs.")
+OBS_ENABLED = conf_bool(
+    "spark.rapids.sql.tpu.obs.enabled", True,
+    "Observability event bus (obs.events): instrumentation chokepoints "
+    "emit span/instant events into a bounded per-query ring, folded into "
+    "session.query_history() profiles.  Disabled cost is one branch per "
+    "site; enabled cost is one lock-protected append per event.")
+OBS_RING_MAX_EVENTS = conf_int(
+    "spark.rapids.sql.tpu.obs.ring.maxEvents", 65536,
+    "Event-ring capacity per query; once full, further events increment "
+    "last_metrics['obsEventsDropped'] instead of growing memory.")
+OBS_HISTORY_MAX = conf_int(
+    "spark.rapids.sql.tpu.obs.history.maxQueries", 16,
+    "Queries session.query_history() retains (oldest profiles — events "
+    "included — are evicted past the bound).")
+OBS_EVENT_LOG_DIR = conf_str(
+    "spark.rapids.sql.tpu.obs.eventLogDir", "",
+    "When set, each query appends its profile header + events as JSONL "
+    "to <dir>/events-<pid>.jsonl (the Spark event-log analogue), the "
+    "input to tools/rapidsprof.py.  Empty disables the log.")
 
 
 def registry() -> List[ConfEntry]:

@@ -38,6 +38,7 @@ from typing import Dict, List, Optional, Tuple
 
 from spark_rapids_tpu.fault import metrics as fault_metrics
 from spark_rapids_tpu.fault.errors import ErrorClass
+from spark_rapids_tpu.obs import events as obs_events
 
 SITES = ("dispatch", "h2d", "d2h", "spill", "unspill", "exchange")
 KINDS = ("oom", "device_lost", "slow")
@@ -168,6 +169,8 @@ def maybe_fire(site: str) -> None:
         return
     rule, count = hit
     fault_metrics.record("faults_injected")
+    obs_events.emit_instant("fault", "injected", at_site=site,
+                            kind=rule.kind, count=count)
     if rule.kind == "oom":
         raise InjectedFault(
             f"RESOURCE_EXHAUSTED: injected OOM at {site} call {count} "

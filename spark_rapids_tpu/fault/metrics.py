@@ -11,6 +11,8 @@ from __future__ import annotations
 import threading
 from typing import Dict
 
+from spark_rapids_tpu.obs import events as obs_events
+
 _LOCK = threading.Lock()
 _STATS: Dict[str, int] = {
     "retries": 0,              # recovery-level replays (any class)
@@ -24,6 +26,12 @@ _STATS: Dict[str, int] = {
 def record(key: str, n: int = 1) -> None:
     with _LOCK:
         _STATS[key] += n
+    # timeline entries for count-shaped keys (wall accumulations like
+    # backoff_wall_ns already have their own spans at the call site)
+    if key == "retries":
+        obs_events.emit_instant("retry", "attempt")
+    elif key in ("device_lost", "partition_fallbacks"):
+        obs_events.emit_instant("fault", key)
 
 
 def snapshot() -> Dict[str, int]:

@@ -49,7 +49,11 @@ class RetryPolicy:
             return
         t0 = time.monotonic_ns()
         time.sleep(d)
-        fault_metrics.record("backoff_wall_ns", time.monotonic_ns() - t0)
+        t1 = time.monotonic_ns()
+        fault_metrics.record("backoff_wall_ns", t1 - t0)
+        from spark_rapids_tpu.obs import events as obs_events
+        obs_events.emit_span("retry", "backoff", t0=t0, t1=t1,
+                             attempt=attempt)
 
     def __repr__(self):
         return (f"RetryPolicy(max_attempts={self.max_attempts}, "

@@ -77,6 +77,10 @@ class partition_deadline:
             if self._done:
                 return
             self.fired = True
+            from spark_rapids_tpu.obs import events as obs_events
+            obs_events.emit_instant("fault", "watchdog_fire",
+                                    label=self.label,
+                                    timeout_s=self.timeout)
             _async_raise(self._tid, PartitionTimeout)
 
     def __exit__(self, exc_type, exc, tb):

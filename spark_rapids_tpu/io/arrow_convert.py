@@ -4,6 +4,7 @@ SURVEY.md section 7)."""
 
 from __future__ import annotations
 
+import time
 from typing import List, Optional
 
 import numpy as np
@@ -11,6 +12,7 @@ import pyarrow as pa
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.batch import HostBatch, HostColumn
+from spark_rapids_tpu.obs import events as obs_events
 
 _ARROW_TO_TYPE = {
     pa.bool_(): T.BOOLEAN,
@@ -54,6 +56,7 @@ def schema_from_arrow(asch: pa.Schema) -> T.Schema:
 
 def arrow_to_host_batch(table_or_batch, schema: Optional[T.Schema] = None
                         ) -> HostBatch:
+    t0 = time.monotonic_ns()
     tb = table_or_batch
     if isinstance(tb, pa.Table):
         tb = tb.combine_chunks()
@@ -99,7 +102,11 @@ def arrow_to_host_batch(table_or_batch, schema: Optional[T.Schema] = None
                 values = np.where(validity, np.nan_to_num(values), 0)
             values = values.astype(f.dtype.np_dtype, copy=False)
         cols.append(HostColumn(f.dtype, values, validity))
-    return HostBatch(schema, cols)
+    hb = HostBatch(schema, cols)
+    obs_events.emit_span("io", "arrow_convert", t0=t0,
+                         t1=time.monotonic_ns(), rows=tb.num_rows,
+                         columns=len(cols))
+    return hb
 
 
 def host_batch_to_arrow(hb: HostBatch) -> pa.Table:

@@ -61,6 +61,7 @@ from spark_rapids_tpu.config import (
     RapidsConf, SPILL_ASYNC_ENABLED, SPILL_CHUNK_BYTES, SPILL_WRITER_THREADS,
     conf_bytes,
 )
+from spark_rapids_tpu.obs import events as obs_events
 
 DEVICE_SPILL_BUDGET = conf_bytes(
     "spark.rapids.memory.tpu.spillBudgetBytes", 8 << 30,
@@ -236,6 +237,7 @@ class SpillableBatch:
         transitions and counters update under it."""
         from spark_rapids_tpu.fault import inject
         cat = self._catalog
+        un_t0 = time.monotonic_ns()
         inject.maybe_fire("unspill")
         host = self._read_disk() if tier == self.TIER_DISK else self._host
         with cat._lock:
@@ -275,6 +277,9 @@ class SpillableBatch:
             if os.path.exists(self._disk_path):
                 os.unlink(self._disk_path)
             self._disk_path = None
+        obs_events.emit_span(
+            "unspill", "disk" if tier == self.TIER_DISK else "host",
+            t0=un_t0, t1=time.monotonic_ns(), bytes=self.device_bytes)
         return dev
 
     def close(self):
@@ -411,6 +416,7 @@ class BufferCatalog:
         self._device_bytes += h.device_bytes
         self.metrics["spilled_to_host"] -= 1
         self.metrics["spill_cancelled"] += 1
+        obs_events.emit_instant("spill", "cancelled")
 
     def _submit(self, task: _SpillTask) -> None:
         with self._lock:
@@ -473,6 +479,9 @@ class BufferCatalog:
                     # stashed failure is moot, don't fail a later get()
                     h._pending_error = None
                 # else: aborted (invalidate/close) mid-copy — drop the copy
+            obs_events.emit_span("spill", "to_host", t0=t0,
+                                 t1=time.monotonic_ns(),
+                                 bytes=nbytes if live else 0)
         except BaseException as e:
             with self._lock:
                 if h._spill_task is task and \
@@ -485,6 +494,8 @@ class BufferCatalog:
                     if not raise_errors:
                         h._pending_error = e
                 task.error = e
+            obs_events.emit_instant("spill", "error",
+                                    error_type=type(e).__name__)
             if raise_errors or not isinstance(e, Exception):
                 raise
             return
@@ -516,6 +527,8 @@ class BufferCatalog:
                     return
                 task = self._begin_spill_locked(victim)
             if self.async_spill:
+                obs_events.emit_instant("spill", "queued",
+                                        bytes=victim.device_bytes)
                 self._submit(task)
             else:
                 self._run_spill_task(task, raise_errors=True)
@@ -577,6 +590,8 @@ class BufferCatalog:
                     victim._spill_task = None
                 self.metrics["spill_wall_ns"] += time.monotonic_ns() - t0
             task.mark_done()
+            obs_events.emit_span("spill", "to_disk", t0=t0,
+                                 t1=time.monotonic_ns(), bytes=enc)
 
     def drain_spills(self) -> None:
         """Join every in-flight async spill (tests, bench, shutdown
@@ -749,6 +764,7 @@ class BufferCatalog:
                 # the read-ahead actually hid an unspill (vs a device hit)
                 with self._lock:
                     self.metrics["unspill_prefetch_hits"] += 1
+                obs_events.emit_instant("unspill", "prefetch_hit")
             return h.get()
 
         window: Deque[ColumnBatch] = deque()

@@ -1,0 +1,131 @@
+"""Event-log and Chrome-trace export.
+
+Two output shapes for one event stream:
+
+* **JSONL event log** (the Spark event-log analogue, conf
+  ``spark.rapids.sql.tpu.obs.eventLogDir``): one ``{"type": "query"}``
+  header line per query followed by its ``{"type": "event"}`` lines —
+  append-only, so one file accumulates a session's queries and
+  ``tools/rapidsprof.py`` post-processes it offline.
+* **Chrome ``trace_event`` JSON** (Perfetto/chrome://tracing loadable):
+  spans as complete ``"X"`` events, instants as ``"i"``, one track per
+  (site, thread) pair named via ``"M"`` thread-name metadata, sorted by
+  timestamp.
+
+Engine-free (stdlib only) and duck-typed over events — Event objects
+in-process, dicts after a JSONL round-trip.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Iterable, List, Tuple
+
+from .events import SPAN, field
+
+
+def _event_dict(ev) -> Dict[str, Any]:
+    if isinstance(ev, dict):
+        return ev
+    return ev.to_dict()
+
+
+# -- chrome trace -------------------------------------------------------------
+
+def events_to_chrome(events: Iterable) -> Dict[str, Any]:
+    """Build a Chrome ``trace_event`` document.  Timestamps convert from
+    monotonic ns to the format's microseconds; tracks (tids) are one per
+    (site, thread) so e.g. the async spill writer's spans never overlap
+    the driver's dispatch spans."""
+    tids: Dict[Tuple[str, str], int] = {}
+    out: List[Dict[str, Any]] = []
+    meta: List[Dict[str, Any]] = []
+
+    def tid_for(site: str, thread: str) -> int:
+        key = (site, thread)
+        tid = tids.get(key)
+        if tid is None:
+            tid = tids[key] = len(tids) + 1
+            meta.append({
+                "name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+                "args": {"name": f"{site}/{thread}"},
+            })
+        return tid
+
+    for ev in events:
+        site = field(ev, "site") or "?"
+        thread = field(ev, "thread") or "?"
+        t0 = int(field(ev, "t0", 0) or 0)
+        t1 = int(field(ev, "t1", 0) or 0)
+        name = field(ev, "name") or site
+        op_id = field(ev, "op_id") or ""
+        args = dict(field(ev, "payload") or {})
+        if op_id:
+            args["op_id"] = op_id
+        rec: Dict[str, Any] = {
+            "name": name, "cat": site, "pid": 1,
+            "tid": tid_for(site, thread), "ts": t0 / 1e3,
+        }
+        if args:
+            rec["args"] = args
+        if field(ev, "kind") == SPAN:
+            rec["ph"] = "X"
+            rec["dur"] = max(0, t1 - t0) / 1e3
+        else:
+            rec["ph"] = "i"
+            rec["s"] = "t"
+        out.append(rec)
+    out.sort(key=lambda r: r["ts"])
+    return {"traceEvents": meta + out, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(path: str, events: Iterable) -> None:
+    doc = events_to_chrome(events)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+
+
+# -- JSONL event log ----------------------------------------------------------
+
+def write_event_log(path: str, query_record: Dict[str, Any],
+                    events: Iterable) -> None:
+    """Append one query (header + events) to the JSONL log at ``path``."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    qid = query_record.get("id", 0)
+    with open(path, "a", encoding="utf-8") as f:
+        f.write(json.dumps(query_record) + "\n")
+        for ev in events:
+            rec = dict(_event_dict(ev))
+            rec["type"] = "event"
+            rec["q"] = qid
+            f.write(json.dumps(rec) + "\n")
+
+
+def read_event_log(path: str) -> List[Dict[str, Any]]:
+    """Parse a JSONL event log back into a list of query dicts, each the
+    header record with its ``"events"`` list attached (rapidsprof's
+    input).  Unknown/blank lines are skipped so a log a crashed process
+    truncated mid-line still loads."""
+    queries: List[Dict[str, Any]] = []
+    by_id: Dict[Any, Dict[str, Any]] = {}
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("type") == "query":
+                rec["events"] = []
+                queries.append(rec)
+                by_id[rec.get("id")] = rec
+            elif rec.get("type") == "event":
+                q = by_id.get(rec.get("q"))
+                if q is not None:
+                    q["events"].append(rec)
+    return queries

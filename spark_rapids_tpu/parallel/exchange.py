@@ -26,6 +26,7 @@ from spark_rapids_tpu.parallel.partitioning import (
 from spark_rapids_tpu.plan.physical import (
     CpuExec, ExecContext, PhysicalOp, TpuExec,
 )
+from spark_rapids_tpu.obs import events as obs_events
 from spark_rapids_tpu.utils.compile_registry import instrumented_jit
 
 def _range_sample_limit(ctx) -> int:
@@ -192,6 +193,7 @@ class TpuShuffleExchangeExec(TpuExec):
         if self._mesh_active(ctx):
             return self._mesh_partitions(ctx)
         ctx.metric(self.op_id, "shuffleElided").add(1)
+        obs_events.emit_instant("exchange", "elided", self.op_id)
         self._ensure_fused_map()
 
         def gen(part):
@@ -322,6 +324,9 @@ class TpuShuffleExchangeExec(TpuExec):
         ctx.metric(self.op_id, "shuffleWireBytes").add(
             stats.get("wire_bytes", 0))
         ctx.metric(self.op_id, "shuffleWallNs").add(wall_ns)
+        obs_events.emit_span(
+            "exchange", "mesh", self.op_id, t0, t0 + wall_ns,
+            bytes=stats.get("payload_bytes", 0), devices=n)
         return [iter([b]) for b in out] if out else \
             [iter([]) for _ in range(n)]
 
@@ -405,8 +410,13 @@ class TpuShuffleExchangeExec(TpuExec):
         ctx.metric(self.op_id, "shuffleBytes").add(
             sum(self._last_part_bytes))
         ctx.metric(self.op_id, "shuffleRows").add(sum(self._last_part_rows))
-        ctx.metric(self.op_id, "shuffleWallNs").add(
-            _time.monotonic_ns() - t0)
+        split_t1 = _time.monotonic_ns()
+        ctx.metric(self.op_id, "shuffleWallNs").add(split_t1 - t0)
+        obs_events.emit_span(
+            "exchange", "split", self.op_id, t0, split_t1,
+            bytes=sum(self._last_part_bytes),
+            rows=sum(self._last_part_rows),
+            pieces=sum(len(p) for p in out), partitions=n)
         # planner-error accounting: the static size estimate the planner
         # used for this exchange's input (stashed by overrides) vs. the
         # actual materialized bytes just recorded — pure host arithmetic
@@ -530,8 +540,7 @@ class TpuShuffleExchangeExec(TpuExec):
                     out[p].append(h)
                     offset += cnt
 
-    @staticmethod
-    def _drain_cached(handles):
+    def _drain_cached(self, handles):
         # lazy, with ONE piece of read-ahead: when piece i is yielded,
         # piece i+1's unspill (an async H2D enqueue) is already in flight,
         # so the consumer's compute overlaps the next transfer.  Handles
@@ -539,6 +548,8 @@ class TpuShuffleExchangeExec(TpuExec):
         # closes them.  The overlap loop itself lives on the catalog
         # (prefetch) — shared with the cached-scan drive path.
         from spark_rapids_tpu.plan.physical import prefetch_spillables
+        obs_events.emit_instant("exchange", "drain", self.op_id,
+                                pieces=len(handles))
         return prefetch_spillables(handles)
 
 

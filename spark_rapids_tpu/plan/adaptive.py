@@ -130,6 +130,8 @@ def note_event(ctx, op_id: str, mechanism: str) -> None:
     note = getattr(ctx, "note_adaptive", None)
     if note is not None:
         note(op_id, mechanism)
+    from spark_rapids_tpu.obs import events as obs_events
+    obs_events.emit_instant("adaptive", mechanism, op_id)
 
 
 # ------------------------------------------------------------- grouping

@@ -221,7 +221,11 @@ class TpuOverrides:
         self.tag(meta)
         self.last_explain = "\n".join(meta.explain_lines())
         if self.conf.explain_enabled:
-            print(self.last_explain)
+            # routed through the obs sink (a logger by default) instead of
+            # print(): library embedders and pytest capture aren't spammed,
+            # and tools can install their own sink (obs.set_explain_sink)
+            from spark_rapids_tpu.obs import explain_sink
+            explain_sink(self.last_explain)
         phys = self._convert(meta)
         phys = _insert_transitions(phys)
         from spark_rapids_tpu.config import FUSION_ENABLED

@@ -436,7 +436,8 @@ def _run_stage(root: PhysicalOp, ctx: ExecContext,
         ctx.metric("pipeline", "programs").add(1)
         dargs = tuple(a for a, m in zip(args, dmask) if m)
         kargs = tuple(a for a, m in zip(args, dmask) if not m)
-        with device_dispatch(ctx, "pipeline", root.name) as holder:
+        with device_dispatch(ctx, "pipeline", root.name,
+                             obs_op=root.op_id) as holder:
             outs = _run_oom_guarded(
                 ctx,
                 lambda: _shrink_outputs(list(jitted(dargs, kargs)), ctx)

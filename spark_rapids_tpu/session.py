@@ -9,7 +9,9 @@ mirroring RapidsExecutorPlugin.init (Plugin.scala:122-146).
 
 from __future__ import annotations
 
+import os
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 from spark_rapids_tpu import types as T
@@ -34,6 +36,9 @@ class TpuSparkSession:
         from spark_rapids_tpu.runtime.device import DeviceRuntime
         self.runtime = DeviceRuntime.get(self.conf) if use_device else None
         self._views: Dict[str, Any] = {}
+        # bounded per-query observability profiles (obs.profile), newest
+        # last; see query_history() / explain_last()
+        self._query_history: List[Any] = []
         # logical-plan -> physical-plan memo: repeated executions of the
         # same DataFrame reuse exec instances and therefore their jax.jit
         # caches (otherwise every collect() recompiles every kernel).
@@ -110,12 +115,14 @@ class TpuSparkSession:
         from spark_rapids_tpu.plan.logical import plan_fingerprint
         from spark_rapids_tpu.plan.overrides import TpuOverrides
         key = plan_fingerprint(plan)
-        # metrics-detail knobs never change the plan: excluding them keeps
-        # the memo (and therefore every compiled kernel) hittable when a
-        # measurement run toggles accurate device-time syncing
+        # metrics-detail and obs knobs never change the plan: excluding
+        # them keeps the memo (and therefore every compiled kernel)
+        # hittable when a measurement run toggles accurate device-time
+        # syncing or the observability bus
         conf_state = tuple(sorted(
             (k, str(v)) for k, v in self.conf._settings.items()
-            if not k.startswith("spark.rapids.sql.tpu.metrics.")))
+            if not (k.startswith("spark.rapids.sql.tpu.metrics.")
+                    or k.startswith("spark.rapids.sql.tpu.obs."))))
         hit = self._plan_cache.get(key)
         if hit is not None and hit[1] == conf_state:
             self.last_explain = hit[3]
@@ -147,9 +154,12 @@ class TpuSparkSession:
         return self._mesh
 
     def execute(self, plan) -> HostBatch:
-        from spark_rapids_tpu.config import FAULTS_SPEC
+        from spark_rapids_tpu.config import (
+            FAULTS_SPEC, OBS_ENABLED, OBS_RING_MAX_EVENTS,
+        )
         from spark_rapids_tpu.fault import inject as fault_inject
         from spark_rapids_tpu.fault import metrics as FM
+        from spark_rapids_tpu.obs import events as obs_events
         from spark_rapids_tpu.plan.physical import ExecContext, collect_host
         from spark_rapids_tpu.utils import compile_registry as CR
         phys = self.plan_physical(plan)
@@ -180,12 +190,23 @@ class TpuSparkSession:
         fault_inject.install(spec)
         self.last_physical_plan = phys
         self.last_exec_ctx = ctx
+        # open the obs epoch exactly around the metric snapshots so the
+        # event window and the CR/FM deltas describe the same interval
+        obs_token = obs_events.begin_query(
+            enabled=OBS_ENABLED.get(self.conf),
+            max_events=OBS_RING_MAX_EVENTS.get(self.conf))
+        t_query0 = time.monotonic_ns()
         before = CR.snapshot()
         fm_before = FM.snapshot()
         cat_before = dict(self.runtime.catalog.metrics) \
             if self.runtime is not None else {}
         try:
             out = collect_host(phys, ctx)
+        except BaseException:
+            # close the epoch so a failed query can't leak its bus into
+            # the next query's window
+            obs_events.end_query(obs_token)
+            raise
         finally:
             if spec:
                 fault_inject.uninstall()
@@ -290,7 +311,59 @@ class TpuSparkSession:
             "spill_to_disk_bytes")
         if self.runtime is not None:
             self.last_metrics["memory"] = dict(self.runtime.catalog.metrics)
+        # drain the obs epoch and fold it into a bounded-history profile
+        # (obs.profile); the event counts become metrics so tests and
+        # bench can assert the bus's own economics
+        obs_events_list, obs_dropped = obs_events.end_query(obs_token)
+        self.last_metrics["obsEventCount"] = len(obs_events_list)
+        self.last_metrics["obsEventsDropped"] = obs_dropped
+        if obs_token is not None:
+            self._record_profile(obs_token, obs_events_list, obs_dropped,
+                                 time.monotonic_ns() - t_query0)
         return out
+
+    def _record_profile(self, query_id: int, events, dropped: int,
+                        wall_ns: int) -> None:
+        """Fold one query's drained events into the bounded history and
+        append to the JSONL event log when configured."""
+        from spark_rapids_tpu.config import (
+            OBS_EVENT_LOG_DIR, OBS_HISTORY_MAX,
+        )
+        from spark_rapids_tpu.obs.profile import QueryProfile
+        scalars = {k: v for k, v in self.last_metrics.items()
+                   if not isinstance(v, dict)}
+        op_metrics = {k: v for k, v in self.last_metrics.items()
+                      if isinstance(v, dict) and k != "memory"}
+        prof = QueryProfile(query_id, events, dropped, wall_ns=wall_ns,
+                            metrics=scalars, op_metrics=op_metrics)
+        self._query_history.append(prof)
+        keep = max(1, OBS_HISTORY_MAX.get(self.conf))
+        while len(self._query_history) > keep:
+            self._query_history.pop(0)
+        log_dir = OBS_EVENT_LOG_DIR.get(self.conf)
+        if log_dir:
+            from spark_rapids_tpu.obs import export as obs_export
+            path = os.path.join(log_dir, f"events-{os.getpid()}.jsonl")
+            obs_export.write_event_log(path, prof.query_record(), events)
+
+    def query_history(self) -> List[Any]:
+        """The last ``spark.rapids.sql.tpu.obs.history.maxQueries``
+        :class:`~spark_rapids_tpu.obs.profile.QueryProfile` objects,
+        oldest first (empty when obs is disabled)."""
+        return list(self._query_history)
+
+    def explain_last(self, metrics: bool = False) -> str:
+        """The last query's explain output; with ``metrics=True`` the
+        physical tree follows, annotated per operator with the last
+        profile's rollups (the SQL-UI exec-metrics analogue)."""
+        base = getattr(self, "last_explain", "") or ""
+        if not metrics:
+            return base
+        phys = getattr(self, "last_physical_plan", None)
+        if phys is None or not self._query_history:
+            return base
+        from spark_rapids_tpu.obs.profile import annotate_plan
+        return base + "\n\n" + annotate_plan(phys, self._query_history[-1])
 
     def prewarm(self, *dataframes) -> Dict[str, int]:
         """Compile the hot bucket set once, ahead of the timed path.

@@ -49,6 +49,7 @@ from typing import Any, Callable, Dict, Optional
 import jax
 
 from spark_rapids_tpu.fault import inject as _fault_inject
+from spark_rapids_tpu.obs import events as _obs_events
 
 _LOCK = threading.Lock()
 _STATS: Dict[str, int] = {
@@ -108,6 +109,10 @@ def record_transfer(kind: str, nbytes: int, wall_ns: int) -> None:
     with _LOCK:
         _STATS[kind + "_bytes"] += int(nbytes)
         _STATS[kind + "_ns"] += int(wall_ns)
+    if _obs_events.active():
+        now = time.monotonic_ns()
+        _obs_events.emit_span(kind, "transfer", t0=now - int(wall_ns),
+                              t1=now, bytes=int(nbytes))
 
 
 # -- use-after-donate guard (tests) ------------------------------------------
@@ -321,9 +326,15 @@ def instrumented_jit(fn: Optional[Callable] = None, *, label: str = "",
                 out = jitted(*args, **kwargs)
         else:
             out = jitted(*args, **kwargs)
+        t1 = time.monotonic_ns()
         after = _cache_size(jitted)
         compiled = after >= 0 and after != before
-        _record(name, compiled, time.monotonic_ns() - t0, donated_bytes)
+        _record(name, compiled, t1 - t0, donated_bytes)
+        if compiled:
+            _obs_events.emit_span("dispatch", name, t0=t0, t1=t1,
+                                  compiled=True)
+        else:
+            _obs_events.emit_span("dispatch", name, t0=t0, t1=t1)
         if donated_leaves:
             _guard_mark(name, donated_leaves)
         return out

@@ -24,6 +24,7 @@ from typing import Optional
 import jax.profiler
 
 from spark_rapids_tpu.config import METRICS_DETAIL
+from spark_rapids_tpu.obs import events as obs_events
 
 
 def metrics_detail(conf) -> bool:
@@ -43,7 +44,8 @@ def trace_range(name: str, metric=None):
 
 
 @contextlib.contextmanager
-def device_dispatch(ctx, op_id: str, name: str):
+def device_dispatch(ctx, op_id: str, name: str,
+                    obs_op: Optional[str] = None):
     """Time one device program dispatch into ``ctx.metric(op_id,
     'deviceTimeNs')`` under a profiler range.
 
@@ -53,15 +55,38 @@ def device_dispatch(ctx, op_id: str, name: str):
     delta IS device execution time; ``deviceTimeSyncs`` counts how many
     accurate samples the total contains.  Detail off: the dispatch wall
     alone is recorded (a lower bound, async dispatch).
+
+    The elapsed time is recorded in a ``finally`` so a dispatch that
+    raises (an injected fault, an OOM about to be retried) still shows
+    in the metric and the profile instead of vanishing; the failed
+    attempt's obs span is tagged ``error``.  ``obs_op`` names the
+    physical-plan node the span is attributed to when the metric op_id
+    is a shared bucket (the pipeline dispatcher passes the stage root's
+    op_id here while keeping the metric under ``"pipeline"``).
     """
     holder: dict = {}
+    err = False
     t0 = time.monotonic_ns()
-    with jax.profiler.TraceAnnotation(f"{op_id}:{name}"):
-        yield holder
-        if metrics_detail(ctx.conf) and holder.get("outputs") is not None:
-            jax.block_until_ready(holder["outputs"])
-            ctx.metric(op_id, "deviceTimeSyncs").add(1)
-    ctx.metric(op_id, "deviceTimeNs").add(time.monotonic_ns() - t0)
+    try:
+        with jax.profiler.TraceAnnotation(f"{op_id}:{name}"):
+            yield holder
+            if metrics_detail(ctx.conf) and \
+                    holder.get("outputs") is not None:
+                jax.block_until_ready(holder["outputs"])
+                ctx.metric(op_id, "deviceTimeSyncs").add(1)
+    except BaseException:
+        err = True
+        raise
+    finally:
+        elapsed = time.monotonic_ns() - t0
+        ctx.metric(op_id, "deviceTimeNs").add(elapsed)
+        if err:
+            ctx.metric(op_id, "deviceTimeErrors").add(1)
+            obs_events.emit_span("device", name, obs_op or op_id,
+                                 t0, t0 + elapsed, error=True)
+        else:
+            obs_events.emit_span("device", name, obs_op or op_id,
+                                 t0, t0 + elapsed)
 
 
 def start_profile(logdir: str):
