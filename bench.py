@@ -425,6 +425,18 @@ def _async_partitions_default() -> bool:
     return bool(PIPELINE_ASYNC_PARTITIONS.get(RapidsConf()))
 
 
+def time_serve():
+    """Serving runtime lane (serve/): the weighted two-tenant template
+    workload from serve.bench — steady-state queries/sec through the
+    scheduler, coalesced-dispatch counts, serial-vs-served wall ratio,
+    bit-parity, and the shared executable cache's second-session
+    compile count (must be 0)."""
+    from spark_rapids_tpu.serve.bench import run_serve_bench
+    return run_serve_bench(queries=32, rows=512,
+                           tenants={"a": 2.0, "b": 1.0},
+                           max_concurrency=2)
+
+
 def time_spill():
     """Spill engine microbench: pre-stage device batches (untimed), then
     register them against a budget that forces most to spill to host and
@@ -517,6 +529,7 @@ def main():
     shuffle_gbps, shuffle_dispatches, shuffle_syncs = time_shuffle()
     spill_gbps, spill_sync_gbps, spill_speedup, spill_depth = time_spill()
     aqe_rps, aqe_speedup, aqe_parity, aqe_counters = time_adaptive()
+    serve = time_serve()
 
     data_bytes = ROWS * _bytes_per_row(data)
     device_s = tpu_econ["device_ms"] / 1e3
@@ -581,6 +594,21 @@ def main():
         # the measured wall cost of the always-on event bus
         "obs_event_count": tpu_econ["obs_event_count"],
         "obs_overhead_pct": tpu_econ["obs_overhead_pct"],
+        # serving runtime economics (serve/): steady-state scheduler
+        # throughput/latency on the weighted two-tenant template
+        # workload, the coalesced-query count, served-vs-serial wall
+        # ratio (bit-parity checked), the shared executable cache's
+        # second-session compile count (0 = every compile amortized
+        # process-wide) and the per-tenant SLO rollups
+        "serve_queries_per_sec": serve["serve_queries_per_sec"],
+        "serve_p50_ms": serve["serve_p50_ms"],
+        "serve_p99_ms": serve["serve_p99_ms"],
+        "serve_batched_queries": serve["serve_batched_queries"],
+        "serve_vs_serial": serve["serve_vs_serial"],
+        "serve_parity": serve["serve_parity"],
+        "serve_second_session_compiles":
+            serve["serve_second_session_compiles"],
+        "serve_tenants": serve["serve_tenants"],
         "platform": platform,
         "scan_rows_per_sec": round(SCAN_ROWS / scan_tpu, 1),
         "scan_vs_baseline": round(scan_cpu / scan_tpu, 3),

@@ -69,17 +69,57 @@ for key in ("value", "donated_bytes", "h2d_gb_per_sec", "d2h_gb_per_sec",
             "aqe_rows_per_sec", "aqe_speedup", "aqe_parity",
             "aqe_coalesced_partitions", "aqe_broadcast_switches",
             "aqe_skew_splits", "aqe_estimate_error_pct",
-            "obs_event_count", "obs_overhead_pct"):
+            "obs_event_count", "obs_overhead_pct",
+            "serve_queries_per_sec", "serve_p50_ms", "serve_p99_ms",
+            "serve_batched_queries", "serve_vs_serial", "serve_parity",
+            "serve_second_session_compiles", "serve_tenants"):
     assert key in j, f"bench JSON missing {key}: {sorted(j)}"
 assert j["value"] > 0, j
 assert j["spill_gb_per_sec"] > 0, j
 assert j["aqe_parity"] is True, j
 assert j["aqe_coalesced_partitions"] > 0, j
+assert j["serve_parity"] is True, j
+assert j["serve_batched_queries"] > 0, j
+assert j["serve_second_session_compiles"] == 0, j
 print("bench smoke ok:", {k: j[k] for k in (
     "value", "donated_bytes", "h2d_gb_per_sec", "d2h_gb_per_sec",
     "shuffle_gb_per_sec", "shuffle_split_dispatches", "shuffle_syncs",
     "async_partitions", "retry_count", "device_lost_count",
     "spill_gb_per_sec", "spill_sync_gb_per_sec")})
+PY
+
+echo "== serve smoke: rapidsserve with 2 weighted tenants and a per-query"
+echo "   dispatch:oom@2 fault — every served query must recover with"
+echo "   correct rows, latencies parseable, per-tenant counts consistent"
+python - << 'PY'
+import json
+import subprocess
+import sys
+
+out = subprocess.run(
+    [sys.executable, "tools/rapidsserve.py", "--tenants", "a:2,b:1",
+     "--queries", "12", "--rows", "256", "--concurrency", "2",
+     "--fault", "dispatch:oom@2"],
+    capture_output=True, text=True, timeout=600)
+assert out.returncode == 0, f"rapidsserve failed:\n{out.stderr[-3000:]}"
+lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+assert lines, f"no JSON line in rapidsserve output:\n{out.stdout[-2000:]}"
+j = json.loads(lines[-1])
+assert j["serve_parity"] is True, j
+assert j["serve_failed"] == 0, j
+assert j["serve_faults_injected"] >= 1, j
+assert float(j["serve_p99_ms"]) > 0, j
+assert float(j["serve_p50_ms"]) <= float(j["serve_p99_ms"]), j
+tenants = j["serve_tenants"]
+assert set(tenants) == {"a", "b"}, tenants
+assert tenants["a"]["weight"] == 2.0 and tenants["b"]["weight"] == 1.0, tenants
+for name, t in tenants.items():
+    assert t["submitted"] == t["completed"] + t["failed"], (name, t)
+assert sum(t["completed"] for t in tenants.values()) == j["serve_completed"], j
+print("serve smoke ok:", {k: j[k] for k in (
+    "serve_queries_per_sec", "serve_p50_ms", "serve_p99_ms",
+    "serve_batched_queries", "serve_faults_injected", "serve_retries",
+    "serve_second_session_compiles")})
 PY
 
 echo "== obs smoke: event log -> rapidsprof report + Perfetto-loadable trace"

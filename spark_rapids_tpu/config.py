@@ -507,6 +507,42 @@ OBS_EVENT_LOG_DIR = conf_str(
     "When set, each query appends its profile header + events as JSONL "
     "to <dir>/events-<pid>.jsonl (the Spark event-log analogue), the "
     "input to tools/rapidsprof.py.  Empty disables the log.")
+SERVE_MAX_CONCURRENCY = conf_int(
+    "spark.rapids.sql.tpu.serve.maxConcurrency", 2,
+    "Runner threads the serving scheduler (serve.scheduler) drives "
+    "queries with — the number of session.execute calls in flight at "
+    "once.  Device admission is still governed per dispatch by "
+    "spark.rapids.sql.concurrentTpuTasks; this bounds host-side query "
+    "parallelism (planning, staging, result assembly).")
+SERVE_BATCH_ENABLED = conf_bool(
+    "spark.rapids.sql.tpu.serve.batch.enabled", True,
+    "Micro-query batching (serve.batching): queued template queries "
+    "that resolve to the same (plan fingerprint, schema, bucket) are "
+    "coalesced into one dispatch — rows concatenated, one execute, "
+    "results split back per caller bit-identically.  false executes "
+    "every submission individually.")
+SERVE_BATCH_MAX_DELAY_MS = conf_float(
+    "spark.rapids.sql.tpu.serve.batch.maxDelayMs", 2.0,
+    "How long a poppable micro-query may wait for coalescing partners "
+    "before it dispatches alone — the latency ceiling batching is "
+    "allowed to add.  0 batches only queries already queued together.")
+SERVE_BATCH_MAX_QUERIES = conf_int(
+    "spark.rapids.sql.tpu.serve.batch.maxQueries", 16,
+    "Cap on queries coalesced into one micro-batch dispatch (bounds "
+    "result-splitting latency and keeps the combined rows inside one "
+    "bucket step).")
+SERVE_DEADLINE_SEC = conf_float(
+    "spark.rapids.sql.tpu.serve.deadlineSec", 0.0,
+    "Default per-query deadline, measured from submit: on expiry the "
+    "watchdog raises a NON_RETRYABLE DeadlineExceeded into the running "
+    "query (no recovery replay — fail fast, neighbors unaffected).  "
+    "Per-submission deadlines override; 0 disables.")
+SERVE_PLAN_CACHE_MAX = conf_int(
+    "spark.rapids.sql.tpu.serve.planCache.maxPlans", 256,
+    "LRU bound on the process-wide shared plan/executable cache "
+    "(serve.excache) — entries pin their physical plans and compiled "
+    "stage programs; past the bound the least-recently-hit plan is "
+    "dropped (its executables fall out with it).")
 
 
 def registry() -> List[ConfEntry]:

@@ -141,11 +141,23 @@ _INSTALL_LOCK = threading.Lock()
 def install(spec: str) -> None:
     """(Re)install the registry from a spec string; empty/None clears it.
     Counters reset on every install, so each query sees a deterministic
-    call numbering."""
+    call numbering.
+
+    Inside a query scope (session.execute installs after opening one)
+    the registry lives ON the scope, so concurrent queries each see only
+    their own session's faults.spec — one query's injected OOMs cannot
+    fire into a neighbor.  Outside any scope (tests arming a site
+    directly, staging paths like ml.to_device_batches) the registry is
+    the process-global one, exactly the historical semantics."""
     global _ACTIVE
+    rules = parse_spec(spec)
+    reg = FaultRegistry(rules) if rules else None
+    sc = obs_events.current_scope()
+    if sc is not None:
+        sc.fault_registry = reg
+        return
     with _INSTALL_LOCK:
-        rules = parse_spec(spec)
-        _ACTIVE = FaultRegistry(rules) if rules else None
+        _ACTIVE = reg
 
 
 def uninstall() -> None:
@@ -153,15 +165,19 @@ def uninstall() -> None:
 
 
 def active() -> bool:
+    sc = obs_events.current_scope()
+    if sc is not None and sc.fault_registry is not None:
+        return True
     return _ACTIVE is not None
 
 
 def maybe_fire(site: str) -> None:
-    """Hot-path hook: no-op (one ``is None`` test) unless a spec is
-    installed.  A matching rule raises :class:`InjectedFault` (oom /
-    device_lost) or sleeps (slow).
+    """Hot-path hook: no-op (one scope probe + ``is None`` test) unless
+    a spec is installed.  A matching rule raises :class:`InjectedFault`
+    (oom / device_lost) or sleeps (slow).
     """
-    reg = _ACTIVE
+    sc = obs_events.current_scope()
+    reg = sc.fault_registry if sc is not None else _ACTIVE
     if reg is None:
         return
     hit = reg.fire(site)

@@ -26,6 +26,10 @@ _STATS: Dict[str, int] = {
 def record(key: str, n: int = 1) -> None:
     with _LOCK:
         _STATS[key] += n
+    # per-query attribution: fault counters also credit the executing
+    # query's scope so concurrent queries don't read each other's
+    # retries/faults out of the global delta
+    obs_events.scope_add(key, n)
     # timeline entries for count-shaped keys (wall accumulations like
     # backoff_wall_ns already have their own spans at the call site)
     if key == "retries":

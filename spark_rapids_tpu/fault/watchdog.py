@@ -45,15 +45,23 @@ class partition_deadline:
     ``spark.rapids.sql.tpu.partition.timeoutSec`` from ``conf``;
     ``partition_deadline(seconds, label)`` takes an explicit timeout.
     Timeout <= 0 disarms (zero overhead beyond one comparison).
+
+    ``exc_type`` overrides the raised class (default
+    :class:`PartitionTimeout`, which classifies DEVICE_LOST and enters
+    recovery).  The serving scheduler arms per-submission deadlines with
+    its own NON_RETRYABLE exception so an expired query aborts out of
+    ``session.execute`` instead of being replayed by the retry ladder.
     """
 
-    def __init__(self, conf_or_secs, label: str = "partition"):
+    def __init__(self, conf_or_secs, label: str = "partition",
+                 exc_type=PartitionTimeout):
         if isinstance(conf_or_secs, (int, float)):
             self.timeout = float(conf_or_secs)
         else:
             from spark_rapids_tpu.config import PARTITION_TIMEOUT_SEC
             self.timeout = float(PARTITION_TIMEOUT_SEC.get(conf_or_secs))
         self.label = label
+        self.exc_type = exc_type
         self.fired = False
         self._thread = None
 
@@ -64,6 +72,10 @@ class partition_deadline:
         self._cancel = threading.Event()
         self._lock = threading.Lock()
         self._done = False
+        from spark_rapids_tpu.obs import events as obs_events
+        # adopt the arming query's scope on the monitor so the fire
+        # event lands in the right query's timeline under concurrency
+        self._scope = obs_events.current_scope()
         self._thread = threading.Thread(
             target=self._watch, daemon=True,
             name=f"partition-deadline:{self.label}")
@@ -78,10 +90,11 @@ class partition_deadline:
                 return
             self.fired = True
             from spark_rapids_tpu.obs import events as obs_events
-            obs_events.emit_instant("fault", "watchdog_fire",
-                                    label=self.label,
-                                    timeout_s=self.timeout)
-            _async_raise(self._tid, PartitionTimeout)
+            with obs_events.adopt(self._scope):
+                obs_events.emit_instant("fault", "watchdog_fire",
+                                        label=self.label,
+                                        timeout_s=self.timeout)
+            _async_raise(self._tid, self.exc_type)
 
     def __exit__(self, exc_type, exc, tb):
         if self._thread is None:
@@ -98,10 +111,10 @@ class partition_deadline:
                 # timeout can neither be lost nor pop at a random later
                 # point
                 _async_revoke(self._tid)
-                raise PartitionTimeout(
+                raise self.exc_type(
                     f"{self.label} exceeded partition.timeoutSec="
                     f"{self.timeout:g}s")
-            if exc_type is not PartitionTimeout:
+            if exc_type is not self.exc_type:
                 # the body raised its OWN error in the same instant the
                 # deadline expired: the async PartitionTimeout is still
                 # pending and would otherwise detonate at an arbitrary

@@ -95,7 +95,7 @@ class _SpillTask:
     cancelled); ``_done`` signals completion to joiners with bounded
     waits."""
 
-    __slots__ = ("handle", "bytes", "state", "error", "_done")
+    __slots__ = ("handle", "bytes", "state", "error", "_done", "scope")
 
     QUEUED, RUNNING, DONE, CANCELLED = "queued", "running", "done", \
         "cancelled"
@@ -106,6 +106,10 @@ class _SpillTask:
         self.state = self.QUEUED
         self.error: Optional[BaseException] = None
         self._done = threading.Event()
+        # the query whose memory pressure queued this move: the writer
+        # thread adopts it so spill events/transfer counters attribute
+        # to the right query under concurrent serving
+        self.scope = obs_events.current_scope()
 
     def mark_done(self) -> None:
         self._done.set()
@@ -441,7 +445,8 @@ class BufferCatalog:
                 while not self._queue:
                     self._queue_cond.wait(_WAIT_SLICE)
                 task = self._queue.popleft()
-            self._run_spill_task(task)
+            with obs_events.adopt(task.scope):
+                self._run_spill_task(task)
 
     def _run_spill_task(self, task: _SpillTask,
                         raise_errors: bool = False) -> None:

@@ -16,7 +16,8 @@ import jax.numpy as jnp
 
 from spark_rapids_tpu.batch import ColumnBatch
 from spark_rapids_tpu.plan.overrides import TpuOverrides
-from spark_rapids_tpu.plan.physical import ExecContext, HostToDeviceExec
+from spark_rapids_tpu.plan.physical import (
+    ExecContext, HostToDeviceExec, _release_admission)
 
 
 def to_device_batches(df) -> List[ColumnBatch]:
@@ -37,6 +38,14 @@ def to_device_batches(df) -> List[ColumnBatch]:
             out.extend(part)
     finally:
         ctx.close_deferred()
+        # This drive loop never routes through DeviceToHostExec (the
+        # batches stay in HBM by design), so the per-batch staging
+        # releases never fire.  The handoff is complete once the loop
+        # ends — drain the outstanding acquires or this task's permit
+        # stays held for the process lifetime and starves every later
+        # query's admission.
+        if ctx.semaphore is not None:
+            _release_admission(ctx, getattr(ctx, "_pipeline_h2d", 0))
     return out
 
 

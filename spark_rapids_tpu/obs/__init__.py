@@ -13,8 +13,8 @@ import logging
 from typing import Callable, Optional
 
 from .events import (  # noqa: F401 — re-exported emitter surface
-    Event, EventBus, active, begin_query, emit_instant, emit_span,
-    end_query,
+    Event, EventBus, QueryScope, active, adopt, begin_query, current_scope,
+    emit_instant, emit_span, end_query,
 )
 
 # -- explain sink -------------------------------------------------------------

@@ -93,7 +93,7 @@ def _release_build_staging(ctx: ExecContext, depth0: int) -> None:
     sem = ctx.semaphore
     if sem is None:
         return
-    extra = max(0, sem.held_depth() - depth0)
+    extra = max(0, sem.task_depth() - depth0)
     for _ in range(extra):
         sem.release()
     if extra and hasattr(ctx, "_pipeline_h2d"):
@@ -1148,7 +1148,7 @@ class TpuNestedLoopJoinExec(TpuExec):
         budget = max(NLJ_PAIR_CAPACITY.get(ctx.conf), 1)
         lsch = self.children[0].output_schema
         rsch = self.children[1].output_schema
-        depth0 = ctx.semaphore.held_depth() if ctx.semaphore else 0
+        depth0 = ctx.semaphore.task_depth() if ctx.semaphore else 0
         rbatches = []
         for p in self.children[1].partitions(ctx):
             rbatches.extend(p)
@@ -1353,7 +1353,7 @@ class TpuBroadcastHashJoinExec(TpuExec):
         cached = self._bc_cache
         if cached is not None and cached[0]() is ctx:
             return cached[1]
-        depth0 = ctx.semaphore.held_depth() if ctx.semaphore else 0
+        depth0 = ctx.semaphore.task_depth() if ctx.semaphore else 0
         batches = []
         for p in self.children[1].partitions(ctx):
             batches.extend(p)

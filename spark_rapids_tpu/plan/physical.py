@@ -291,19 +291,25 @@ class HostToDeviceExec(TpuExec):
             # pays the host-side copy/transfer-enqueue cost.  Producer
             # back-pressure and shutdown ride the channel's condition
             # variable, so neither pays a poll interval.
+            from spark_rapids_tpu.obs import events as obs_events
             from spark_rapids_tpu.runtime.device import DeviceRuntime
             catalog = DeviceRuntime.get(ctx.conf).catalog
             chan = _ReadAheadChannel(depth)
             DONE = object()
+            # adopt the spawning query's scope on the worker so its
+            # transfers/events attribute to THIS query even when several
+            # queries are in flight (serve runtime)
+            scope = obs_events.current_scope()
 
             def worker():
                 try:
-                    for hb in part:
-                        if chan.stopped:
-                            return
-                        if not chan.put(("b", stage_nosem(hb, catalog))):
-                            return
-                    chan.put((DONE, None))
+                    with obs_events.adopt(scope):
+                        for hb in part:
+                            if chan.stopped:
+                                return
+                            if not chan.put(("b", stage_nosem(hb, catalog))):
+                                return
+                        chan.put((DONE, None))
                 except BaseException as e:  # surfaced on the consumer side
                     chan.put(("e", e))
 

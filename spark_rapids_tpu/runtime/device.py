@@ -25,34 +25,51 @@ class TpuSemaphore:
 
     Unlike a plain semaphore it is re-entrant per TASK, matching
     GpuSemaphore.acquireIfNecessary semantics (GpuSemaphore.scala:74-87).
-    In this single-process engine a query IS the task, and a query's device
-    work spans threads: the main thread consumes while stage read-ahead
-    workers (plan/physical.py gen_pipelined) drive nested plan sections.
-    The hold depth is therefore shared across threads — a worker whose
-    nested TPU section acquires while the main thread already holds the
+    A query IS the task — identified by its ``obs.events`` QueryScope —
+    and a query's device work spans threads: the main thread consumes
+    while stage read-ahead workers (plan/physical.py gen_pipelined) drive
+    nested plan sections.  The hold depth is therefore shared across the
+    task's threads (bound or adopted into its scope) — a worker whose
+    nested TPU section acquires while the consumer already holds the
     permit re-enters instead of deadlocking against its own consumer
     (thread-local depth wedged exactly that way: the worker blocked on the
     permit the main thread held while the main thread blocked on the
-    worker's queue).  Releases pair by count, on any thread.
+    worker's queue).  Releases pair by count, on any of the task's
+    threads.
+
+    With several queries in flight (the serving runtime), each holds its
+    own depth entry, so two concurrent queries genuinely contend for
+    permits instead of merging into one task — with ``permits=1`` their
+    device phases serialize.  Work outside any query scope shares one
+    process-wide default task (key None), the historical behavior.
     """
 
     def __init__(self, permits: int):
         self._permits = max(1, permits)
         self._cond = threading.Condition()
         self._available = self._permits
-        self._depth = 0
+        # task key (QueryScope or None) -> re-entrant hold depth; a task
+        # present in the map holds exactly one permit
+        self._depths = {}
+
+    @staticmethod
+    def _task_key():
+        from spark_rapids_tpu.obs import events as obs_events
+        return obs_events.task_key()
 
     def acquire(self):
+        key = self._task_key()
         with self._cond:
             while True:
-                if self._depth > 0:
+                depth = self._depths.get(key, 0)
+                if depth > 0:
                     # the task already holds a permit (possibly taken by a
                     # sibling thread while this one waited): re-enter
-                    self._depth += 1
+                    self._depths[key] = depth + 1
                     return
                 if self._available > 0:
                     self._available -= 1
-                    self._depth = 1
+                    self._depths[key] = 1
                     return
                 # bounded wait: release/notify still wakes immediately;
                 # the bound only caps the C-level block so the fault
@@ -61,25 +78,41 @@ class TpuSemaphore:
                 self._cond.wait(0.25)
 
     def release(self):
+        key = self._task_key()
         with self._cond:
-            if self._depth <= 0:
+            depth = self._depths.get(key, 0)
+            if depth <= 0:
                 return
-            self._depth -= 1
-            if self._depth == 0:
+            if depth == 1:
+                del self._depths[key]
                 self._available += 1
                 self._cond.notify()
+            else:
+                self._depths[key] = depth - 1
 
     def release_all(self):
+        """Drop the calling task's whole hold (recovery: the failed
+        attempt's permits must not outlive it).  Other tasks' holds are
+        untouched — under concurrency their queries are still live."""
+        key = self._task_key()
         with self._cond:
-            if self._depth > 0:
-                self._depth = 0
+            if self._depths.pop(key, 0) > 0:
                 self._available += 1
                 self._cond.notify()
 
-    def held_depth(self) -> int:
-        """The task's re-entrant hold depth (0 = no permit held)."""
+    def task_depth(self) -> int:
+        """The CALLING task's re-entrant hold depth (0 = no permit held)
+        — for acquire/release bookkeeping deltas within one query."""
+        key = self._task_key()
         with self._cond:
-            return self._depth
+            return self._depths.get(key, 0)
+
+    def held_depth(self) -> int:
+        """Total hold depth across ALL tasks (0 = nothing held by
+        anyone) — the leak-detection contract plan_verify and the suite
+        assert after every query/storm."""
+        with self._cond:
+            return sum(self._depths.values())
 
 
 class DeviceRuntime:

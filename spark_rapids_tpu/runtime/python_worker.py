@@ -67,7 +67,7 @@ def python_worker_slot(ctx):
     sem = _semaphore(ctx.conf)
     released_device = False
     if ctx.semaphore is not None and \
-            getattr(ctx.semaphore, "held_depth", lambda: 0)() > 0:
+            getattr(ctx.semaphore, "task_depth", lambda: 0)() > 0:
         ctx.semaphore.release()
         released_device = True
     sem.acquire()

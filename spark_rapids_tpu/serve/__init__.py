@@ -1,0 +1,22 @@
+"""Serving runtime: a multi-tenant scheduler layered above
+``session.execute`` (serve.scheduler), the process-wide shared
+plan/executable cache it amortizes compiles through (serve.excache),
+and micro-query batching for template workloads (serve.batching).
+See docs/serving.md.
+"""
+
+from spark_rapids_tpu.serve.batching import MicroBatcher, QueryTemplate
+from spark_rapids_tpu.serve.excache import SharedPlanCache, shared_plan_cache
+from spark_rapids_tpu.serve.scheduler import (
+    DeadlineExceeded, ServeFuture, ServeScheduler,
+)
+
+__all__ = [
+    "DeadlineExceeded",
+    "MicroBatcher",
+    "QueryTemplate",
+    "ServeFuture",
+    "ServeScheduler",
+    "SharedPlanCache",
+    "shared_plan_cache",
+]

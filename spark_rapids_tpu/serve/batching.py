@@ -1,0 +1,223 @@
+"""Micro-query batching: coalesce same-shape template queries into one
+dispatch.
+
+Serving workloads are dominated by *template* queries — the same
+filter/project shape over a small per-request batch of rows.  Executing
+each individually pays the full per-dispatch overhead (staging, device
+admission, result assembly) for a handful of rows.  This module
+coalesces queued queries that resolve to the same **group** —
+``(template key, input schema signature, row bucket)`` — into a single
+execution: rows concatenated with a hidden ``__serve_qid`` column,
+one ``session.execute``, results split back per caller bit-identically.
+
+Executable reuse across dispatches is by construction: every group owns
+ONE mutable batches-holder list bound into ONE logical plan.  Each
+dispatch replaces ``holder[0]`` with the newly combined batch —
+``InMemoryScan`` (and its physical ``CpuInMemoryScanExec``) hold the
+list *by reference* and read it at ``partitions()`` time, and
+``plan_fingerprint`` keys batch lists by identity, so the fingerprint
+is constant across dispatches: every dispatch after the first hits the
+shared plan cache, and when the combined rows land in the same bucket
+the compiled stage program is reused too (``compileCount == 0``).
+
+Correctness contract: templates must be **row-wise and
+order-preserving** (filter / project / with_column).  Both preserve
+input row order, so the concatenated queries' qid blocks stay
+contiguous in the output and the split-back is a pair of binary
+searches per caller.  The qid column is threaded through the template's
+plan mechanically (:func:`_inject_qid` appends a passthrough reference
+to every ``Project``); templates containing any other operator —
+aggregates reduce across callers' rows, sorts interleave them — are
+rejected at bind time with a clear error.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.batch import BUCKETS, HostBatch, HostColumn
+
+#: Hidden column carrying each row's originating query id through the
+#: batched plan; appended last at bind time, stripped before results
+#: return to callers.
+QID_COLUMN = "__serve_qid"
+
+
+class QueryTemplate:
+    """A named, reusable query shape for :meth:`ServeScheduler.submit_micro`.
+
+    ``build`` maps a scan DataFrame (schema = the submitted batch's
+    schema) to the result DataFrame using only row-wise,
+    order-preserving operations (``filter`` / ``select`` /
+    ``with_column``).  ``key`` identifies the template across
+    submissions — two submissions coalesce only when their keys,
+    input schemas and row buckets all match."""
+
+    def __init__(self, key: str, build: Callable[[Any], Any]):
+        self.key = str(key)
+        self.build = build
+
+    def __repr__(self):
+        return f"QueryTemplate({self.key!r})"
+
+
+def schema_signature(schema: T.Schema) -> Tuple:
+    """Hashable identity of an input schema for group matching."""
+    return tuple((f.name, str(f.dtype), bool(f.nullable))
+                 for f in schema.fields)
+
+
+def group_key(template: QueryTemplate, batch: HostBatch) -> Tuple:
+    """The coalescing identity: same template, same input schema, same
+    row bucket (so combined sizes stay near one bucket step)."""
+    return (template.key, schema_signature(batch.schema),
+            BUCKETS.rows(max(1, batch.num_rows)))
+
+
+def _inject_qid(plan):
+    """Rewrite a row-wise logical plan so :data:`QID_COLUMN` flows from
+    the scan to the output (appended as the LAST output column).
+
+    ``Filter`` passes every input column through untouched; ``Project``
+    gains a trailing passthrough reference.  Any other node breaks the
+    per-row caller attribution micro-batching depends on and is
+    rejected."""
+    from spark_rapids_tpu.exprs.base import ColumnRef
+    from spark_rapids_tpu.plan import logical as L
+    if isinstance(plan, L.InMemoryScan):
+        # the bound scan already carries the qid column (appended last)
+        return plan
+    if isinstance(plan, L.Filter):
+        return L.Filter(plan.condition, _inject_qid(plan.children[0]))
+    if isinstance(plan, L.Project):
+        child = _inject_qid(plan.children[0])
+        if QID_COLUMN in plan.names:
+            return L.Project(plan.exprs, plan.names, child)
+        return L.Project(
+            plan.exprs + [ColumnRef(QID_COLUMN, T.LONG, False)],
+            plan.names + [QID_COLUMN], child)
+    raise ValueError(
+        f"micro-batch template produced a {type(plan).__name__} node: "
+        "templates must be row-wise and order-preserving "
+        "(filter/select/with_column only) so batched callers' rows "
+        "cannot mix")
+
+
+def _with_qid_column(batch: HostBatch, qid: int) -> HostBatch:
+    """``batch`` plus a constant int64 qid column appended last."""
+    n = batch.num_rows
+    col = HostColumn(T.LONG, np.full(n, qid, dtype=np.int64),
+                     np.ones(n, dtype=np.bool_))
+    schema = T.Schema(list(batch.schema.fields) + [T.Field(QID_COLUMN,
+                                                           T.LONG, False)])
+    return HostBatch(schema, list(batch.columns) + [col])
+
+
+def _strip_qid(batch: HostBatch) -> HostBatch:
+    """Drop the trailing qid column before returning rows to a caller."""
+    assert batch.schema.fields[-1].name == QID_COLUMN
+    return HostBatch(T.Schema(list(batch.schema.fields[:-1])),
+                     list(batch.columns[:-1]))
+
+
+class BoundGroup:
+    """One group's bound state: the mutable batches holder and the
+    qid-threaded logical plan built over it (built ONCE; reused —
+    identity-stable — for every dispatch of the group)."""
+
+    def __init__(self, session, template: QueryTemplate,
+                 schema: T.Schema):
+        from spark_rapids_tpu.dataframe import DataFrame
+        from spark_rapids_tpu.plan.logical import InMemoryScan
+        qid_schema = T.Schema(list(schema.fields)
+                              + [T.Field(QID_COLUMN, T.LONG, False)])
+        #: ONE batch object, REFILLED in place per dispatch
+        #: (plan_fingerprint keys it by identity, so the fingerprint —
+        #: and with it the shared-plan-cache entry and its compiled
+        #: stages — survives across dispatches).  Safe because
+        #: dispatches are serialized per group and the engine's
+        #: id-keyed batch maps are per-execution transients.
+        self._batch = HostBatch(qid_schema, [
+            HostColumn(f.dtype,
+                       np.empty(0, dtype=object) if (f.dtype.is_string
+                                                     or f.dtype.is_array)
+                       else np.empty(0, dtype=f.dtype.np_dtype),
+                       np.empty(0, dtype=np.bool_))
+            for f in qid_schema.fields])
+        self.holder: List[HostBatch] = [self._batch]
+        scan = InMemoryScan(self.holder, qid_schema, num_partitions=1)
+        built = template.build(DataFrame(scan, session))
+        self.plan = _inject_qid(built.plan)
+        self._lock = threading.Lock()
+
+    def dispatch(self, session, requests: List[Tuple[int, HostBatch]]):
+        """Execute one coalesced dispatch for ``requests`` (``(qid,
+        batch)`` pairs, any order) and return ``({qid: HostBatch},
+        metrics)``."""
+        # ascending qid order keeps the output qid column non-decreasing
+        # (row-wise plans preserve row order), so the per-caller
+        # split-back is a binary search
+        requests = sorted(requests, key=lambda r: r[0])
+        combined = HostBatch.concat(
+            [_with_qid_column(b, qid) for qid, b in requests])
+        with self._lock:
+            # one dispatch at a time per group: the holder batch is
+            # shared state and the plan (hence its compiled stages) is
+            # bound to it by reference — refill, don't replace
+            self._batch.columns = combined.columns
+            self._batch.num_rows = combined.num_rows
+            out, metrics = session.execute_with_metrics(self.plan)
+            qids = np.asarray(out.columns[-1].values, dtype=np.int64) \
+                if out.num_rows else np.empty(0, dtype=np.int64)
+            results: Dict[int, HostBatch] = {}
+            for qid, _b in requests:
+                lo = int(np.searchsorted(qids, qid, side="left"))
+                hi = int(np.searchsorted(qids, qid, side="right"))
+                results[qid] = _strip_qid(out.slice(lo, hi - lo))
+        return results, metrics
+
+
+#: Process-wide bound-group registry: like the shared plan cache, the
+#: binding (holder batch + qid-threaded plan + its compiled stages) is
+#: identity-keyed state, so every scheduler serving the same template
+#: group must share ONE BoundGroup or each would recompile from scratch.
+_GROUPS: Dict[Tuple, BoundGroup] = {}
+_GROUPS_LOCK = threading.Lock()
+
+
+class MicroBatcher:
+    """Bound-group front end for one scheduler: resolves group keys to
+    the process-shared :class:`BoundGroup` bindings and tracks this
+    scheduler's own coalescing counters."""
+
+    def __init__(self, session):
+        self.session = session
+        self._lock = threading.Lock()
+        #: queries that rode a shared dispatch (batch size >= 2)
+        self.batched_queries = 0
+        self.dispatches = 0
+
+    def bind(self, template: QueryTemplate, key: Tuple,
+             schema: T.Schema) -> BoundGroup:
+        with _GROUPS_LOCK:
+            grp = _GROUPS.get(key)
+            if grp is not None:
+                return grp
+        # build outside the registry lock (planning can be slow); ties
+        # broken first-insert-wins like the shared plan cache
+        grp = BoundGroup(self.session, template, schema)
+        with _GROUPS_LOCK:
+            return _GROUPS.setdefault(key, grp)
+
+    def run(self, grp: BoundGroup,
+            requests: List[Tuple[int, HostBatch]]):
+        results, metrics = grp.dispatch(self.session, requests)
+        with self._lock:
+            self.dispatches += 1
+            if len(requests) > 1:
+                self.batched_queries += len(requests)
+        return results, metrics

@@ -1,0 +1,142 @@
+"""Process-wide shared physical-plan / executable cache.
+
+PR 2 introduced the plan-fingerprint memo so re-executing the same
+DataFrame reuses physical exec instances and therefore their
+``jax.jit`` caches; until this PR the memo lived per session
+(``session._plan_cache``), so N sessions serving the same query shape
+each paid the full compile tax.  This module lifts the memo to a
+lock-guarded process singleton: the compiled executables live on the
+physical plan's op instances (``plan/pipeline._stage_program`` caches
+jits on the root op), so sharing the plan object shares every
+executable — the second session's warm execution reports
+``compileCount == 0``.
+
+Keying is (plan fingerprint, plan-relevant conf state); see
+``session.plan_physical`` for what the conf state excludes.  Entries
+are LRU-bounded (``spark.rapids.sql.tpu.serve.planCache.maxPlans``)
+because cached plans pin their source batches.
+
+Metrics stay attributed per query: the cache only shares PLANS; every
+execution still opens its own QueryScope and counts its own dispatches
+(a shared-cache hit shows up precisely as ``compileCount == 0``).
+
+Thread safety: lookups and inserts hold the cache lock; plan BUILDING
+(``TpuOverrides.apply``) runs outside it so a slow lowering cannot
+stall unrelated sessions.  Two sessions racing to build the same key
+both build; the first insert wins and the loser adopts the winner's
+plan (build is pure planning — no device state — so discarding the
+duplicate is free).
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from collections import OrderedDict
+from typing import Any, Callable, Tuple
+
+DEFAULT_MAX_PLANS = 256
+
+
+class SharedPlanCache:
+    """Fingerprint -> (logical plan ref, conf state, physical plan,
+    explain) with LRU eviction, shared by every session in the process.
+
+    Entry lifetime is tied to the LOGICAL plan's liveness: the entry
+    holds only a weak reference to the root logical node, and dead
+    entries are swept on every access.  A serving client (DataFrame,
+    QueryTemplate bound group, bench probe) keeps its plan object
+    alive, so its entry — and the compiled executables on the physical
+    plan — persist across sessions; a batch/test workload that builds
+    hundreds of one-shot plans releases each entry (physical plan,
+    executables, pinned source batches) as soon as the plan goes out of
+    scope, instead of pinning ``maxPlans`` worth of dead queries for
+    the life of the process.  This is also what keeps the id()-keyed
+    plan fingerprint sound: an entry can never outlive the batch
+    objects its fingerprint identifies, so a recycled ``id()`` cannot
+    produce a false hit."""
+
+    def __init__(self, max_plans: int = DEFAULT_MAX_PLANS):
+        self._lock = threading.Lock()
+        self._plans: "OrderedDict[Any, Tuple]" = OrderedDict()
+        self._max = max(1, int(max_plans))
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def _ref(plan: Any):
+        try:
+            return weakref.ref(plan)
+        except TypeError:
+            # not weakrefable: fall back to a strong holder with the
+            # same call signature (entry then lives until LRU eviction)
+            return lambda: plan
+
+    def _sweep_locked(self) -> None:
+        dead = [k for k, ent in self._plans.items() if ent[0]() is None]
+        for k in dead:
+            del self._plans[k]
+
+    def set_max_plans(self, max_plans: int) -> None:
+        with self._lock:
+            self._max = max(1, int(max_plans))
+            self._sweep_locked()
+            while len(self._plans) > self._max:
+                self._plans.popitem(last=False)
+
+    def get_or_build(self, key: Any, conf_state: Tuple,
+                     builder: Callable[[], Tuple[Any, Any, str]]):
+        """Return ``(phys, explain, hit)`` for ``key``; on miss call
+        ``builder() -> (plan, phys, explain)`` outside the lock and
+        insert first-writer-wins.
+
+        The stored key is ``(key, conf_state)``: two sessions with
+        different plan-relevant conf alternating over the same
+        fingerprint each keep their own entry instead of thrashing
+        one slot (and re-compiling on every alternation)."""
+        full = (key, conf_state)
+        with self._lock:
+            self._sweep_locked()
+            ent = self._plans.get(full)
+            if ent is not None:
+                self._plans.move_to_end(full)
+                self.hits += 1
+                return ent[2], ent[3], True
+        plan, phys, explain = builder()
+        with self._lock:
+            ent = self._plans.get(full)
+            if ent is not None and ent[0]() is not None:
+                # a concurrent builder won the race: use ITS plan so
+                # both sessions share one set of executables
+                self._plans.move_to_end(full)
+                self.hits += 1
+                return ent[2], ent[3], True
+            self.misses += 1
+            self._plans[full] = (self._ref(plan), conf_state, phys, explain)
+            self._plans.move_to_end(full)
+            while len(self._plans) > self._max:
+                self._plans.popitem(last=False)
+        return phys, explain, False
+
+    def stats(self):
+        with self._lock:
+            self._sweep_locked()
+            return {"plan_cache_entries": len(self._plans),
+                    "plan_cache_hits": self.hits,
+                    "plan_cache_misses": self.misses}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._plans.clear()
+
+    def __len__(self):
+        with self._lock:
+            return len(self._plans)
+
+
+_SHARED: SharedPlanCache = SharedPlanCache()
+
+
+def shared_plan_cache() -> SharedPlanCache:
+    """The process singleton every ``session.plan_physical`` consults."""
+    return _SHARED

@@ -19,6 +19,21 @@ from spark_rapids_tpu.batch import HostBatch
 from spark_rapids_tpu.config import RapidsConf, conf as global_conf
 
 
+class _MetricsFrame:
+    """Per-call holder for one query's metrics dict.
+
+    ``execute_with_metrics`` fills a frame, then publishes it to
+    ``session.last_metrics`` with a single reference assignment — the
+    serving runtime runs N executes against one session, and filling
+    ``self.last_metrics`` in place would let a concurrent reader observe
+    a half-written mixture of two queries."""
+
+    __slots__ = ("last_metrics",)
+
+    def __init__(self, op_metrics: Dict[str, Any]):
+        self.last_metrics: Dict[str, Any] = op_metrics
+
+
 class TpuSparkSession:
     _lock = threading.Lock()
     _active: Optional["TpuSparkSession"] = None
@@ -37,12 +52,22 @@ class TpuSparkSession:
         self.runtime = DeviceRuntime.get(self.conf) if use_device else None
         self._views: Dict[str, Any] = {}
         # bounded per-query observability profiles (obs.profile), newest
-        # last; see query_history() / explain_last()
+        # last; see query_history() / explain_last().  Guarded by
+        # _history_lock: the serving runtime executes on N threads
+        # against one session.
         self._query_history: List[Any] = []
-        # logical-plan -> physical-plan memo: repeated executions of the
-        # same DataFrame reuse exec instances and therefore their jax.jit
-        # caches (otherwise every collect() recompiles every kernel).
-        self._plan_cache: Dict[int, Any] = {}
+        self._history_lock = threading.Lock()
+        # last completed query's metrics; REPLACED wholesale per query
+        # (never mutated in place) so concurrent readers see a
+        # consistent dict
+        self.last_metrics: Dict[str, Any] = {}
+        # the logical-plan -> physical-plan memo is process-wide
+        # (serve.excache.SharedPlanCache): N sessions serving the same
+        # query shape share exec instances and therefore every compiled
+        # executable.  Size it from this session's conf.
+        from spark_rapids_tpu.config import SERVE_PLAN_CACHE_MAX
+        from spark_rapids_tpu.serve.excache import shared_plan_cache
+        shared_plan_cache().set_max_plans(SERVE_PLAN_CACHE_MAX.get(self.conf))
         with TpuSparkSession._lock:
             TpuSparkSession._active = self
 
@@ -111,9 +136,12 @@ class TpuSparkSession:
         (GpuOverrides + Spark plan canonicalization): two structurally
         identical DataFrames (e.g. ``df.count()`` called twice, each
         building a fresh Aggregate node) share one physical plan and
-        therefore every compiled XLA kernel."""
+        therefore every compiled XLA kernel.  The memo is PROCESS-wide
+        (serve.excache): every session serving the same (fingerprint,
+        conf-state) shape shares one physical plan, so only the first
+        execution anywhere in the process compiles."""
         from spark_rapids_tpu.plan.logical import plan_fingerprint
-        from spark_rapids_tpu.plan.overrides import TpuOverrides
+        from spark_rapids_tpu.serve.excache import shared_plan_cache
         key = plan_fingerprint(plan)
         # metrics-detail and obs knobs never change the plan: excluding
         # them keeps the memo (and therefore every compiled kernel)
@@ -123,17 +151,16 @@ class TpuSparkSession:
             (k, str(v)) for k, v in self.conf._settings.items()
             if not (k.startswith("spark.rapids.sql.tpu.metrics.")
                     or k.startswith("spark.rapids.sql.tpu.obs."))))
-        hit = self._plan_cache.get(key)
-        if hit is not None and hit[1] == conf_state:
-            self.last_explain = hit[3]
-            return hit[2]
-        overrides = TpuOverrides(self.conf)
-        phys = overrides.apply(plan)
-        if len(self._plan_cache) > 256:
-            self._plan_cache.clear()
-        self._plan_cache[key] = (plan, conf_state, phys,
-                                 overrides.last_explain)
-        self.last_explain = overrides.last_explain
+
+        def _build():
+            from spark_rapids_tpu.plan.overrides import TpuOverrides
+            overrides = TpuOverrides(self.conf)
+            phys = overrides.apply(plan)
+            return plan, phys, overrides.last_explain
+
+        phys, explain, _hit = shared_plan_cache().get_or_build(
+            key, conf_state, _build)
+        self.last_explain = explain
         return phys
 
     def _shuffle_mesh(self):
@@ -154,6 +181,17 @@ class TpuSparkSession:
         return self._mesh
 
     def execute(self, plan) -> HostBatch:
+        out, _metrics = self.execute_with_metrics(plan)
+        return out
+
+    def execute_with_metrics(self, plan) -> Tuple[HostBatch, Dict[str, Any]]:
+        """Execute and return ``(rows, this query's metrics dict)``.
+
+        ``self.last_metrics`` is also published (one reference
+        assignment, so concurrent executes on a shared session never
+        expose a half-written dict), but under concurrency only the
+        returned dict is guaranteed to describe THIS call — the serving
+        scheduler uses it for per-tenant rollups."""
         from spark_rapids_tpu.config import (
             FAULTS_SPEC, OBS_ENABLED, OBS_RING_MAX_EVENTS,
         )
@@ -180,21 +218,24 @@ class TpuSparkSession:
         # with sql.enabled=false to replay a failed partition on the CPU
         # operator path (fault.recovery)
         ctx.logical_plan = plan
-        # (re)install the deterministic fault registry per query: call
-        # counters reset so "the Nth dispatch" is query-relative; an
-        # empty spec clears any previously installed registry, and the
-        # finally clears an armed one so persistent @N+ rules cannot
+        self.last_physical_plan = phys
+        self.last_exec_ctx = ctx
+        # open the query scope exactly around the metric snapshots so
+        # the event window and the CR/FM deltas describe the same
+        # interval; the scope also carries this query's counters and
+        # fault registry under concurrent serving
+        obs_token = obs_events.begin_query(
+            enabled=OBS_ENABLED.get(self.conf),
+            max_events=OBS_RING_MAX_EVENTS.get(self.conf))
+        # (re)install the deterministic fault registry per query (on the
+        # scope just opened, so concurrent queries keep separate specs):
+        # call counters reset so "the Nth dispatch" is query-relative;
+        # an empty spec clears any previously installed registry, and
+        # the finally clears an armed one so persistent @N+ rules cannot
         # outlive the query and fire at sites with no recovery around
         # them (e.g. ml.to_device_batches staging outside execute)
         spec = FAULTS_SPEC.get(self.conf)
         fault_inject.install(spec)
-        self.last_physical_plan = phys
-        self.last_exec_ctx = ctx
-        # open the obs epoch exactly around the metric snapshots so the
-        # event window and the CR/FM deltas describe the same interval
-        obs_token = obs_events.begin_query(
-            enabled=OBS_ENABLED.get(self.conf),
-            max_events=OBS_RING_MAX_EVENTS.get(self.conf))
         t_query0 = time.monotonic_ns()
         before = CR.snapshot()
         fm_before = FM.snapshot()
@@ -203,52 +244,61 @@ class TpuSparkSession:
         try:
             out = collect_host(phys, ctx)
         except BaseException:
-            # close the epoch so a failed query can't leak its bus into
+            # close the scope so a failed query can't leak its bus into
             # the next query's window
             obs_events.end_query(obs_token)
             raise
         finally:
             if spec:
                 fault_inject.uninstall()
-        fm_d = FM.delta(fm_before, FM.snapshot())
-        d = CR.delta(before, CR.snapshot())
-        self.last_metrics = {
+        if obs_token is not None:
+            # per-scope counters: exactly this query's activity, even
+            # with N queries in flight (the global snapshot delta would
+            # mix them)
+            d = obs_token.counters_for(before)
+            fm_d = obs_token.counters_for(fm_before)
+        else:
+            # nested execute (prewarm, recovery re-lowering) rides the
+            # outer scope: fall back to the historical global deltas
+            fm_d = FM.delta(fm_before, FM.snapshot())
+            d = CR.delta(before, CR.snapshot())
+        frame = _MetricsFrame({
             op: {name: m.value for name, m in ms.items()}
-            for op, ms in ctx.metrics.items()}
+            for op, ms in ctx.metrics.items()})
         # compile/dispatch economics for THIS query (process-wide counters
         # snapshotted around the collect; compiledShapes is the cumulative
         # compiled-executable cardinality the bucket policy bounds)
-        self.last_metrics["compileCount"] = d["compiles"]
-        self.last_metrics["compileWallNs"] = d["compile_wall_ns"]
-        self.last_metrics["dispatchCount"] = d["dispatches"]
-        self.last_metrics["backendCompileNs"] = d["backend_compile_ns"]
-        self.last_metrics["compiledShapes"] = CR.compiled_shapes()
+        frame.last_metrics["compileCount"] = d["compiles"]
+        frame.last_metrics["compileWallNs"] = d["compile_wall_ns"]
+        frame.last_metrics["dispatchCount"] = d["dispatches"]
+        frame.last_metrics["backendCompileNs"] = d["backend_compile_ns"]
+        frame.last_metrics["compiledShapes"] = CR.compiled_shapes()
         # data-plane economics: input bytes donated to dispatches (HBM
         # reused for outputs) and the host<->device staging volume/time
-        self.last_metrics["donatedBytes"] = d["donated_bytes"]
-        self.last_metrics["h2dBytes"] = d["h2d_bytes"]
-        self.last_metrics["h2dTimeNs"] = d["h2d_ns"]
-        self.last_metrics["d2hBytes"] = d["d2h_bytes"]
-        self.last_metrics["d2hTimeNs"] = d["d2h_ns"]
-        self.last_metrics["deviceTimeNs"] = sum(
+        frame.last_metrics["donatedBytes"] = d["donated_bytes"]
+        frame.last_metrics["h2dBytes"] = d["h2d_bytes"]
+        frame.last_metrics["h2dTimeNs"] = d["h2d_ns"]
+        frame.last_metrics["d2hBytes"] = d["d2h_bytes"]
+        frame.last_metrics["d2hTimeNs"] = d["d2h_ns"]
+        frame.last_metrics["deviceTimeNs"] = sum(
             ms["deviceTimeNs"].value for ms in ctx.metrics.values()
             if "deviceTimeNs" in ms)
         # shuffle split economics, summed over every exchange op: split
         # programs dispatched, blocking host syncs paid, catalog pieces
         # registered, and the bytes/wall the split moved (GB/s derivable)
-        self.last_metrics["shuffleSplitDispatches"] = sum(
+        frame.last_metrics["shuffleSplitDispatches"] = sum(
             ms["shuffleSplitDispatches"].value for ms in ctx.metrics.values()
             if "shuffleSplitDispatches" in ms)
-        self.last_metrics["shuffleSyncs"] = sum(
+        frame.last_metrics["shuffleSyncs"] = sum(
             ms["shuffleSyncs"].value for ms in ctx.metrics.values()
             if "shuffleSyncs" in ms)
-        self.last_metrics["shufflePieces"] = sum(
+        frame.last_metrics["shufflePieces"] = sum(
             ms["shufflePieces"].value for ms in ctx.metrics.values()
             if "shufflePieces" in ms)
-        self.last_metrics["shuffleBytes"] = sum(
+        frame.last_metrics["shuffleBytes"] = sum(
             ms["shuffleBytes"].value for ms in ctx.metrics.values()
             if "shuffleBytes" in ms)
-        self.last_metrics["shuffleWallNs"] = sum(
+        frame.last_metrics["shuffleWallNs"] = sum(
             ms["shuffleWallNs"].value for ms in ctx.metrics.values()
             if "shuffleWallNs" in ms)
         # adaptive-execution economics (plan/adaptive), summed over every
@@ -257,20 +307,20 @@ class TpuSparkSession:
         # skewed partitions isolated/split, and the volume of host-known
         # statistics those decisions consumed (all recorded with zero
         # extra host syncs — the shuffle split already fetched them)
-        self.last_metrics["aqeCoalescedPartitions"] = sum(
+        frame.last_metrics["aqeCoalescedPartitions"] = sum(
             ms["aqeCoalescedPartitions"].value
             for ms in ctx.metrics.values()
             if "aqeCoalescedPartitions" in ms)
-        self.last_metrics["aqeBroadcastSwitches"] = sum(
+        frame.last_metrics["aqeBroadcastSwitches"] = sum(
             ms["aqeBroadcastSwitches"].value for ms in ctx.metrics.values()
             if "aqeBroadcastSwitches" in ms)
-        self.last_metrics["aqeSkewSplits"] = sum(
+        frame.last_metrics["aqeSkewSplits"] = sum(
             ms["aqeSkewSplits"].value for ms in ctx.metrics.values()
             if "aqeSkewSplits" in ms)
-        self.last_metrics["aqeStatsRows"] = sum(
+        frame.last_metrics["aqeStatsRows"] = sum(
             ms["aqeStatsRows"].value for ms in ctx.metrics.values()
             if "aqeStatsRows" in ms)
-        self.last_metrics["aqeStatsBytes"] = sum(
+        frame.last_metrics["aqeStatsBytes"] = sum(
             ms["aqeStatsBytes"].value for ms in ctx.metrics.values()
             if "aqeStatsBytes" in ms)
         # planner size-estimate error vs. actual shuffle bytes, averaged
@@ -279,17 +329,17 @@ class TpuSparkSession:
         _errs = [ms["aqeEstimateErrorPct"].value
                  for ms in ctx.metrics.values()
                  if "aqeEstimateErrorPct" in ms]
-        self.last_metrics["aqeEstimateErrorPct"] = \
+        frame.last_metrics["aqeEstimateErrorPct"] = \
             sum(_errs) / len(_errs) if _errs else 0.0
         # fault-tolerance economics (fault.metrics deltas): recovery
         # replays, deterministic-backoff wall, device losses handled,
         # partitions completed via the CPU path, and injected faults
-        self.last_metrics["retryCount"] = fm_d["retries"]
-        self.last_metrics["backoffWallNs"] = fm_d["backoff_wall_ns"]
-        self.last_metrics["deviceLostCount"] = fm_d["device_lost"]
-        self.last_metrics["partitionFallbackCount"] = \
+        frame.last_metrics["retryCount"] = fm_d["retries"]
+        frame.last_metrics["backoffWallNs"] = fm_d["backoff_wall_ns"]
+        frame.last_metrics["deviceLostCount"] = fm_d["device_lost"]
+        frame.last_metrics["partitionFallbackCount"] = \
             fm_d["partition_fallbacks"]
-        self.last_metrics["faultsInjected"] = fm_d["faults_injected"]
+        frame.last_metrics["faultsInjected"] = fm_d["faults_injected"]
         # spill-engine economics for THIS query (catalog counters are
         # process-cumulative, so delta against the pre-query snapshot):
         # writer wall, peak writer-queue depth, read-aheads that hid an
@@ -300,46 +350,53 @@ class TpuSparkSession:
         def cat_delta(key):
             return cat_now.get(key, 0) - cat_before.get(key, 0)
 
-        self.last_metrics["spillWallNs"] = cat_delta("spill_wall_ns")
-        self.last_metrics["spillQueueDepthMax"] = \
+        frame.last_metrics["spillWallNs"] = cat_delta("spill_wall_ns")
+        frame.last_metrics["spillQueueDepthMax"] = \
             cat_now.get("spill_queue_depth_max", 0)
-        self.last_metrics["unspillPrefetchHits"] = \
+        frame.last_metrics["unspillPrefetchHits"] = \
             cat_delta("unspill_prefetch_hits")
-        self.last_metrics["spillToHostBytes"] = cat_delta(
+        frame.last_metrics["spillToHostBytes"] = cat_delta(
             "spill_to_host_bytes")
-        self.last_metrics["spillToDiskBytes"] = cat_delta(
+        frame.last_metrics["spillToDiskBytes"] = cat_delta(
             "spill_to_disk_bytes")
         if self.runtime is not None:
-            self.last_metrics["memory"] = dict(self.runtime.catalog.metrics)
+            frame.last_metrics["memory"] = dict(self.runtime.catalog.metrics)
         # drain the obs epoch and fold it into a bounded-history profile
         # (obs.profile); the event counts become metrics so tests and
         # bench can assert the bus's own economics
         obs_events_list, obs_dropped = obs_events.end_query(obs_token)
-        self.last_metrics["obsEventCount"] = len(obs_events_list)
-        self.last_metrics["obsEventsDropped"] = obs_dropped
-        if obs_token is not None:
-            self._record_profile(obs_token, obs_events_list, obs_dropped,
-                                 time.monotonic_ns() - t_query0)
-        return out
+        frame.last_metrics["obsEventCount"] = len(obs_events_list)
+        frame.last_metrics["obsEventsDropped"] = obs_dropped
+        # publish by one reference assignment: a concurrent reader of
+        # self.last_metrics sees the previous complete dict or this one,
+        # never a half-filled frame
+        self.last_metrics = frame.last_metrics
+        if obs_token is not None and obs_token.bus is not None:
+            self._record_profile(obs_token.query_id, obs_events_list,
+                                 obs_dropped,
+                                 time.monotonic_ns() - t_query0,
+                                 frame.last_metrics)
+        return out, frame.last_metrics
 
     def _record_profile(self, query_id: int, events, dropped: int,
-                        wall_ns: int) -> None:
+                        wall_ns: int, metrics: Dict[str, Any]) -> None:
         """Fold one query's drained events into the bounded history and
         append to the JSONL event log when configured."""
         from spark_rapids_tpu.config import (
             OBS_EVENT_LOG_DIR, OBS_HISTORY_MAX,
         )
         from spark_rapids_tpu.obs.profile import QueryProfile
-        scalars = {k: v for k, v in self.last_metrics.items()
+        scalars = {k: v for k, v in metrics.items()
                    if not isinstance(v, dict)}
-        op_metrics = {k: v for k, v in self.last_metrics.items()
+        op_metrics = {k: v for k, v in metrics.items()
                       if isinstance(v, dict) and k != "memory"}
         prof = QueryProfile(query_id, events, dropped, wall_ns=wall_ns,
                             metrics=scalars, op_metrics=op_metrics)
-        self._query_history.append(prof)
         keep = max(1, OBS_HISTORY_MAX.get(self.conf))
-        while len(self._query_history) > keep:
-            self._query_history.pop(0)
+        with self._history_lock:
+            self._query_history.append(prof)
+            while len(self._query_history) > keep:
+                self._query_history.pop(0)
         log_dir = OBS_EVENT_LOG_DIR.get(self.conf)
         if log_dir:
             from spark_rapids_tpu.obs import export as obs_export
@@ -350,7 +407,8 @@ class TpuSparkSession:
         """The last ``spark.rapids.sql.tpu.obs.history.maxQueries``
         :class:`~spark_rapids_tpu.obs.profile.QueryProfile` objects,
         oldest first (empty when obs is disabled)."""
-        return list(self._query_history)
+        with self._history_lock:
+            return list(self._query_history)
 
     def explain_last(self, metrics: bool = False) -> str:
         """The last query's explain output; with ``metrics=True`` the

@@ -102,6 +102,17 @@ def _record(label: str, compiled: bool, wall_ns: int,
             _STATS["compiles"] += 1
             _STATS["compile_wall_ns"] += wall_ns
             _LABEL_COMPILES[label] = _LABEL_COMPILES.get(label, 0) + 1
+    # credit the executing query's scope as well: under concurrent
+    # serving the global delta mixes queries, so session.execute reads
+    # these per-scope counters instead
+    sc = _obs_events.current_scope()
+    if sc is not None:
+        sc.add("dispatches", 1)
+        if donated_bytes:
+            sc.add("donated_bytes", donated_bytes)
+        if compiled:
+            sc.add("compiles", 1)
+            sc.add("compile_wall_ns", wall_ns)
 
 
 def record_transfer(kind: str, nbytes: int, wall_ns: int) -> None:
@@ -109,6 +120,10 @@ def record_transfer(kind: str, nbytes: int, wall_ns: int) -> None:
     with _LOCK:
         _STATS[kind + "_bytes"] += int(nbytes)
         _STATS[kind + "_ns"] += int(wall_ns)
+    sc = _obs_events.current_scope()
+    if sc is not None:
+        sc.add(kind + "_bytes", int(nbytes))
+        sc.add(kind + "_ns", int(wall_ns))
     if _obs_events.active():
         now = time.monotonic_ns()
         _obs_events.emit_span(kind, "transfer", t0=now - int(wall_ns),
@@ -354,6 +369,11 @@ def _on_event_duration(event: str, duration_secs: float, **kw) -> None:
         return
     with _LOCK:
         _STATS["backend_compile_ns"] += int(duration_secs * 1e9)
+    # the listener fires on the dispatching thread mid-jit, so the
+    # current scope is the compiling query's
+    sc = _obs_events.current_scope()
+    if sc is not None:
+        sc.add("backend_compile_ns", int(duration_secs * 1e9))
 
 
 def _hook_monitoring() -> None:

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""rapidsserve — drive the serving runtime and report its economics.
+
+Usage:
+    python tools/rapidsserve.py [--tenants a:2,b:1] [--queries N]
+        [--rows N] [--concurrency N] [--fault SPEC] [--deadline SEC]
+
+Runs the deterministic serving workload from
+``spark_rapids_tpu.serve.bench`` — template micro-queries round-robined
+across weighted tenants, served concurrently with micro-batching — and
+prints ONE JSON line with the ``serve_*`` metrics: queries/sec, p50/p99
+latency, coalesced-query count, served-vs-serial wall ratio, bit-parity
+vs one-at-a-time execution, the shared executable cache's
+second-session compile count, and per-tenant SLO rollups.
+
+``--fault`` installs a per-query deterministic fault spec (e.g.
+``dispatch:oom@2``) on the serving session: every served query injects
+it and must still return correct rows through the recovery ladder —
+the CI serve smoke drives exactly that.  ``--deadline`` arms a
+per-query deadline (seconds; queries that miss it fail fast with
+DeadlineExceeded and count in ``serve_deadline_exceeded``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _parse_tenants(spec: str):
+    """``a:2,b:1`` -> {"a": 2.0, "b": 1.0} (weight defaults to 1)."""
+    out = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, weight = part.partition(":")
+        out[name.strip()] = float(weight) if weight else 1.0
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="rapidsserve", description=__doc__.splitlines()[0])
+    ap.add_argument("--tenants", default="a:2,b:1",
+                    help="comma list of name:weight (default a:2,b:1)")
+    ap.add_argument("--queries", type=int, default=32,
+                    help="queries to serve (default 32)")
+    ap.add_argument("--rows", type=int, default=512,
+                    help="rows per query batch (default 512)")
+    ap.add_argument("--concurrency", type=int, default=2,
+                    help="scheduler runner threads (default 2)")
+    ap.add_argument("--fault", default="",
+                    help="faults.spec to inject per served query")
+    ap.add_argument("--deadline", type=float, default=0.0,
+                    help="per-query deadline seconds (0 = off)")
+    args = ap.parse_args(argv)
+    sys.path.insert(0, REPO_ROOT)
+    from spark_rapids_tpu.serve.bench import run_serve_bench
+    result = run_serve_bench(
+        queries=max(1, args.queries), rows=max(1, args.rows),
+        tenants=_parse_tenants(args.tenants) or {"default": 1.0},
+        fault=args.fault, deadline_sec=args.deadline,
+        max_concurrency=max(1, args.concurrency))
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
