@@ -72,9 +72,13 @@ for key in ("value", "donated_bytes", "h2d_gb_per_sec", "d2h_gb_per_sec",
             "obs_event_count", "obs_overhead_pct",
             "serve_queries_per_sec", "serve_p50_ms", "serve_p99_ms",
             "serve_batched_queries", "serve_vs_serial", "serve_parity",
-            "serve_second_session_compiles", "serve_tenants"):
+            "serve_second_session_compiles", "serve_tenants",
+            "scan_gb_per_sec", "scan_decode_gb_per_sec",
+            "scan_h2d_overlap_pct", "scan_chunks_skipped",
+            "scan_v2_vs_v1"):
     assert key in j, f"bench JSON missing {key}: {sorted(j)}"
 assert j["value"] > 0, j
+assert j["scan_gb_per_sec"] > 0, j
 assert j["spill_gb_per_sec"] > 0, j
 assert j["aqe_parity"] is True, j
 assert j["aqe_coalesced_partitions"] > 0, j

@@ -102,14 +102,35 @@ class HostColumn:
     dtype: T.DataType
     values: np.ndarray  # object ndarray of str|None for strings
     validity: np.ndarray  # bool, True = valid
+    #: Dictionary-encoded strings (scan v2, docs/io.md): ``values`` hold
+    #: int32 codes into this object array of entries, so staging moves
+    #: indices instead of per-row bytes.  ``None`` = plain column.
+    dictionary: Optional[np.ndarray] = None
 
     def __post_init__(self):
         self.values = np.asarray(self.values)
         self.validity = np.asarray(self.validity, dtype=np.bool_)
         assert len(self.values) == len(self.validity)
+        if self.dictionary is not None:
+            self.dictionary = np.asarray(self.dictionary, dtype=object)
 
     def __len__(self) -> int:
         return len(self.values)
+
+    def decoded(self) -> "HostColumn":
+        """Materialize a dictionary-encoded column to plain values (no-op
+        for plain columns)."""
+        if self.dictionary is None:
+            return self
+        n = len(self.values)
+        values = np.empty(n, dtype=object)
+        nd = len(self.dictionary)
+        codes = np.asarray(self.values, dtype=np.int64)
+        for i in range(n):
+            c = codes[i]
+            values[i] = (str(self.dictionary[c])
+                         if self.validity[i] and 0 <= c < nd else "")
+        return HostColumn(self.dtype, values, self.validity)
 
     @staticmethod
     def from_list(dtype: T.DataType, items: Sequence[Any]) -> "HostColumn":
@@ -127,6 +148,8 @@ class HostColumn:
         return HostColumn(dtype, values, validity)
 
     def to_list(self) -> List[Any]:
+        if self.dictionary is not None:
+            return self.decoded().to_list()
         out: List[Any] = []
         elem = self.dtype.element if self.dtype.is_array else None
         for v, ok in zip(self.values, self.validity):
@@ -186,7 +209,7 @@ class HostBatch:
     def slice(self, start: int, length: int) -> "HostBatch":
         cols = [
             HostColumn(c.dtype, c.values[start : start + length],
-                       c.validity[start : start + length])
+                       c.validity[start : start + length], c.dictionary)
             for c in self.columns
         ]
         return HostBatch(self.schema, cols)
@@ -197,8 +220,11 @@ class HostBatch:
         schema = batches[0].schema
         cols = []
         for i, f in enumerate(schema.fields):
-            values = np.concatenate([b.columns[i].values for b in batches])
-            validity = np.concatenate([b.columns[i].validity for b in batches])
+            # dictionary-encoded parts decode first: dictionaries differ
+            # per source chunk, so the concatenated column is plain
+            parts = [b.columns[i].decoded() for b in batches]
+            values = np.concatenate([p.values for p in parts])
+            validity = np.concatenate([p.validity for p in parts])
             cols.append(HostColumn(f.dtype, values, validity))
         return HostBatch(schema, cols)
 
@@ -212,13 +238,25 @@ class HostBatch:
 
 
 class DeviceColumn:
-    """One column staged in HBM: data buffer + validity mask (+ offsets)."""
+    """One column staged in HBM: data buffer + validity mask (+ offsets).
 
-    def __init__(self, dtype: T.DataType, data, validity, offsets=None):
+    Dictionary-encoded strings (scan v2, docs/io.md) additionally carry
+    ``codes`` — int32[cap] indices into the dictionary entries that
+    data/offsets then describe — plus the static ``mat_byte_cap``: the
+    byte-capacity bucket the column occupies once materialized
+    (``kernels.layout.dict_decode_column``).  Encoded columns exist only
+    between scan staging and the first consuming operator; every exec
+    materializes at entry unless it is explicitly encode-aware.
+    """
+
+    def __init__(self, dtype: T.DataType, data, validity, offsets=None,
+                 codes=None, mat_byte_cap: int = 0):
         self.dtype = dtype
         self.data = data
         self.validity = validity
         self.offsets = offsets  # strings only: int32[cap+1]
+        self.codes = codes  # dict-encoded strings only: int32[cap]
+        self.mat_byte_cap = int(mat_byte_cap)
 
     @property
     def is_string(self) -> bool:
@@ -229,14 +267,26 @@ class DeviceColumn:
         """Strings and arrays: flat element buffer + offsets."""
         return self.offsets is not None
 
+    @property
+    def is_dict(self) -> bool:
+        """Dictionary-encoded string column (codes + dictionary buffers)."""
+        return self.codes is not None
+
     def tree_flatten(self):
+        if self.codes is not None:
+            return ((self.data, self.validity, self.offsets, self.codes),
+                    (self.dtype, True, True, self.mat_byte_cap))
         if self.offsets is None:
-            return (self.data, self.validity), (self.dtype, False)
-        return (self.data, self.validity, self.offsets), (self.dtype, True)
+            return (self.data, self.validity), (self.dtype, False, False, 0)
+        return ((self.data, self.validity, self.offsets),
+                (self.dtype, True, False, 0))
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        dtype, has_offsets = aux
+        dtype, has_offsets, has_codes, mat_byte_cap = aux
+        if has_codes:
+            data, validity, offsets, codes = children
+            return cls(dtype, data, validity, offsets, codes, mat_byte_cap)
         if has_offsets:
             data, validity, offsets = children
             return cls(dtype, data, validity, offsets)
@@ -245,7 +295,8 @@ class DeviceColumn:
 
     def __repr__(self):
         shape = getattr(self.data, "shape", None)
-        return f"DeviceColumn({self.dtype}, data={shape})"
+        enc = ", dict" if self.codes is not None else ""
+        return f"DeviceColumn({self.dtype}, data={shape}{enc})"
 
 
 jax.tree_util.register_pytree_node(
@@ -356,6 +407,26 @@ def host_column_to_device(col: HostColumn, capacity: int,
     validity = np.zeros(capacity, dtype=np.bool_)
     validity[:n] = col.validity
     put = (lambda x: jax.device_put(x, device)) if device is not None else jax.device_put
+    if col.dictionary is not None and col.dtype.is_string:
+        # dictionary-encoded staging: ship int32 codes plus the (small)
+        # dictionary buffers instead of per-row string bytes
+        entries = col.dictionary
+        nd = max(len(entries), 1)
+        ent_valid = np.ones(len(entries), dtype=np.bool_)
+        d_offsets, d_data = _string_host_to_buffers(entries, ent_valid)
+        dcap = round_up_capacity(nd)
+        full_d_off = np.full(dcap + 1, d_offsets[-1], dtype=np.int32)
+        full_d_off[: len(entries) + 1] = d_offsets
+        raw = np.asarray(col.values, dtype=np.int64)
+        safe = np.where(col.validity, np.clip(raw, 0, nd - 1), 0)
+        codes = np.zeros(capacity, dtype=np.int32)
+        codes[:n] = safe
+        ent_lens = (d_offsets[1:] - d_offsets[:-1]).astype(np.int64)
+        mat_total = int(ent_lens[safe[col.validity]].sum()) \
+            if len(entries) and n else 0
+        return DeviceColumn(col.dtype, put(d_data), put(validity),
+                            put(full_d_off), put(codes),
+                            BUCKETS.elems(mat_total))
     if col.dtype.is_string or col.dtype.is_array:
         if col.dtype.is_string:
             offsets, data = _string_host_to_buffers(col.values, col.validity)
@@ -413,7 +484,8 @@ def device_to_host_many(batches: Sequence[ColumnBatch]) -> List[HostBatch]:
     t0 = time.monotonic_ns()
     host = jax.device_get([
         (b.num_rows,
-         [(c.data, c.validity, c.offsets) if c.offsets is not None
+         [(c.data, c.validity, c.offsets, c.codes) if c.codes is not None
+          else (c.data, c.validity, c.offsets) if c.offsets is not None
           else (c.data, c.validity) for c in b.columns])
         for b in batches])
     nbytes = sum(
@@ -427,7 +499,22 @@ def device_to_host_many(batches: Sequence[ColumnBatch]) -> List[HostBatch]:
         out_cols = []
         for f, bufs in zip(batch.schema.fields, col_bufs):
             validity = np.asarray(bufs[1])[:n]
-            if f.dtype.is_string:
+            if f.dtype.is_string and len(bufs) == 4:
+                # dictionary-encoded: decode the (small) dictionary once,
+                # then fan the per-row codes out through it — D2H always
+                # returns plain values (dict columns never leave the
+                # scan->device corridor)
+                d_off = np.asarray(bufs[2])
+                raw = np.asarray(bufs[0]).tobytes()
+                codes = np.asarray(bufs[3])[:n]
+                nd = int(codes.max()) + 1 if n else 0
+                entries = [raw[d_off[i]:d_off[i + 1]].decode(
+                    "utf-8", errors="replace") for i in range(nd)]
+                values = np.empty(n, dtype=object)
+                for i in range(n):
+                    values[i] = entries[codes[i]] if validity[i] else ""
+                out_cols.append(HostColumn(f.dtype, values, validity))
+            elif f.dtype.is_string:
                 # one bytes() copy + per-row slicing of it: slicing a bytes
                 # object is a cheap memcpy, vs. the per-row ndarray slice +
                 # bytes() pair this replaced (2 object allocs + dtype
@@ -468,7 +555,10 @@ def host_batch_bytes(hb: HostBatch) -> int:
     every value and must never sit on a per-call budget path."""
     total = 0
     for c in hb.columns:
-        if c.dtype.is_string:
+        if c.dictionary is not None:
+            total += c.values.nbytes + len(c.dictionary) + sum(
+                len(str(x)) for x in c.dictionary)
+        elif c.dtype.is_string:
             total += sum(len(str(x)) for x in c.values) + len(c.values)
         else:
             total += c.values.nbytes
@@ -485,8 +575,20 @@ def host_sizes(batches: Sequence[ColumnBatch]) -> List[Tuple[int, List[int]]]:
     """
     from spark_rapids_tpu.utils.compile_registry import guard_check
     guard_check(list(batches), "host_sizes")
+
+    def _varlen_total(c):
+        if c.codes is not None:
+            # Dictionary-encoded: report the MATERIALIZED byte total (what
+            # any gather/concat consumer will hold after its row-layout
+            # guard decodes the column), not the dictionary's size.
+            ent_lens = (c.offsets[1:] - c.offsets[:-1]).astype(jnp.int32)
+            nd = int(c.offsets.shape[0]) - 1
+            codes_c = jnp.clip(c.codes, 0, max(nd - 1, 0))
+            return jnp.sum(jnp.where(c.validity, ent_lens[codes_c], 0))
+        return c.offsets[-1]
+
     scalars = [(b.num_rows,
-                [c.offsets[-1] for c in b.columns if c.is_varlen])
+                [_varlen_total(c) for c in b.columns if c.is_varlen])
                for b in batches]
     host = jax.device_get(scalars)
     return [(int(n), [int(t) for t in totals]) for n, totals in host]

@@ -273,6 +273,36 @@ SCAN_PUSHDOWN_ENABLED = conf_bool(
     "Push filter conjuncts into file scans: parquet row groups are "
     "skipped on min/max statistics and Hive key=value partition "
     "directories are pruned before any decode.")
+SCAN_V2_ENABLED = conf_bool(
+    "spark.rapids.sql.tpu.scan.v2.enabled", True,
+    "Parallel scan pipeline (io/scan_v2): sub-file decode parallelism "
+    "(parquet row groups / ORC stripes as independent tasks on a "
+    "process-shared decode pool), streaming chunk emission so decode "
+    "overlaps host->device staging, plus the dictEncoding and "
+    "lateMaterialization features below.  Off restores the v1 "
+    "file-at-a-time scan (bit-identical results either way).")
+SCAN_READAHEAD_DEPTH = conf_int(
+    "spark.rapids.sql.tpu.scan.readAhead.depth", 4,
+    "Decode tasks kept in flight ahead of the scan consumer by the v2 "
+    "pipeline (bounded sliding window over the shared decode pool). "
+    "Chunks are still yielded in deterministic file/chunk order.  "
+    "<=1 decodes one chunk at a time (no read-ahead).")
+SCAN_DICT_ENCODING_ENABLED = conf_bool(
+    "spark.rapids.sql.tpu.scan.dictEncoding.enabled", True,
+    "Keep parquet dictionary-encoded string columns encoded through "
+    "host->device staging: the device carries int32 codes plus the "
+    "(small) dictionary buffers, so H2D moves indices instead of string "
+    "bytes and encode-aware kernels (filter eq, hash/group keys) work "
+    "on codes; other kernels materialize on demand.  Only active under "
+    "scan v2 when the scan feeds the device directly.")
+SCAN_LATE_MAT_ENABLED = conf_bool(
+    "spark.rapids.sql.tpu.scan.lateMaterialization.enabled", True,
+    "Late materialization for pushed-predicate scans (v2): decode the "
+    "predicate columns of a row-group chunk first, evaluate the pushed "
+    "conjuncts, and decode the remaining projected columns only when "
+    "the chunk has surviving rows — chunks with zero survivors are "
+    "skipped entirely (the Filter above re-applies the predicate, so "
+    "this only ever drops whole all-false chunks).")
 AQE_COALESCE_ENABLED = conf_bool(
     "spark.rapids.sql.adaptive.coalescePartitions.enabled", True,
     "Group small post-shuffle partitions so each downstream task covers "

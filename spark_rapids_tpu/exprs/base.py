@@ -25,34 +25,63 @@ class DevVal:
 
     For strings ``data`` is the flat uint8 byte buffer and ``offsets`` the
     int32[cap+1] row offsets; otherwise ``data`` is [cap] of the jnp dtype.
+
+    A dictionary-encoded string value (scan v2) additionally carries
+    ``codes`` (int32[cap] row -> entry indices; data/offsets then describe
+    the dictionary ENTRIES) and the static ``mat_byte_cap`` it would
+    materialize into.  Only :func:`eval_maybe_encoded` produces these —
+    ``from_column`` always materializes, so no kernel sees an encoded
+    value it did not ask for.
     """
 
     dtype: T.DataType
     data: Any
     validity: Any
     offsets: Any = None
+    codes: Any = None
+    mat_byte_cap: int = 0
 
     @property
     def capacity(self) -> int:
+        if self.codes is not None:
+            return int(self.codes.shape[0])
         if self.offsets is not None:
             return int(self.offsets.shape[0]) - 1
         return int(self.data.shape[0])
 
     def to_column(self) -> DeviceColumn:
-        return DeviceColumn(self.dtype, self.data, self.validity, self.offsets)
+        return DeviceColumn(self.dtype, self.data, self.validity,
+                            self.offsets, self.codes, self.mat_byte_cap)
 
     @staticmethod
     def from_column(col: DeviceColumn) -> "DevVal":
+        if col.codes is not None:
+            from spark_rapids_tpu.kernels.layout import dict_decode_column
+            col = dict_decode_column(col)
         return DevVal(col.dtype, col.data, col.validity, col.offsets)
 
+    @staticmethod
+    def from_column_encoded(col: DeviceColumn) -> "DevVal":
+        """Wrap a column verbatim, KEEPING dictionary encoding — only for
+        callers that handle encoded values (hash/eq/group-key paths)."""
+        return DevVal(col.dtype, col.data, col.validity, col.offsets,
+                      col.codes, col.mat_byte_cap)
+
     def tree_flatten(self):
+        if self.codes is not None:
+            return ((self.data, self.validity, self.offsets, self.codes),
+                    (self.dtype, True, True, self.mat_byte_cap))
         if self.offsets is None:
-            return (self.data, self.validity), (self.dtype, False)
-        return (self.data, self.validity, self.offsets), (self.dtype, True)
+            return (self.data, self.validity), (self.dtype, False, False, 0)
+        return ((self.data, self.validity, self.offsets),
+                (self.dtype, True, False, 0))
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        dtype, has_offsets = aux
+        dtype, has_offsets, has_codes, mat_byte_cap = aux
+        if has_codes:
+            data, validity, offsets, codes = children
+            return cls(dtype, data, validity, offsets, codes, mat_byte_cap)
         if has_offsets:
             data, validity, offsets = children
             return cls(dtype, data, validity, offsets)
@@ -250,6 +279,20 @@ class BoundRef(Expression):
 
     def cpu_eval(self, ctx: CpuEvalCtx) -> CpuVal:
         return CpuVal.from_column(ctx.batch.columns[self.ordinal])
+
+
+def eval_maybe_encoded(expr: "Expression", ctx: TpuEvalCtx) -> DevVal:
+    """Evaluate ``expr``, keeping dictionary encoding when it is a bare
+    column reference.  Only hash/eq-based consumers (string equality
+    predicates, group keys) may call this — every other path goes through
+    ``tpu_eval`` → ``from_column`` which materializes."""
+    while isinstance(expr, Alias):
+        expr = expr.children[0]
+    if isinstance(expr, ColumnRef):
+        return DevVal.from_column_encoded(ctx.batch.column(expr.column))
+    if isinstance(expr, BoundRef):
+        return DevVal.from_column_encoded(ctx.batch.columns[expr.ordinal])
+    return expr.tpu_eval(ctx)
 
 
 class Literal(Expression):

@@ -44,7 +44,14 @@ class _Comparison(BinaryExpression):
         return None
 
     def tpu_eval(self, ctx) -> DevVal:
-        lv, rv = self.left.tpu_eval(ctx), self.right.tpu_eval(ctx)
+        if self.left.dtype.is_string and self._supports_string():
+            # Hash-based equality works directly on dictionary-encoded
+            # columns — keep the encoding so the dictionary is hashed once.
+            from spark_rapids_tpu.exprs.base import eval_maybe_encoded
+            lv = eval_maybe_encoded(self.left, ctx)
+            rv = eval_maybe_encoded(self.right, ctx)
+        else:
+            lv, rv = self.left.tpu_eval(ctx), self.right.tpu_eval(ctx)
         if lv.dtype.is_string:
             data = self._compute_string_dev(lv, rv)
             return DevVal(T.BOOLEAN, data, lv.validity & rv.validity)
@@ -122,7 +129,12 @@ class EqualNullSafe(BinaryExpression):
         return None
 
     def tpu_eval(self, ctx) -> DevVal:
-        lv, rv = self.left.tpu_eval(ctx), self.right.tpu_eval(ctx)
+        if self.left.dtype.is_string:
+            from spark_rapids_tpu.exprs.base import eval_maybe_encoded
+            lv = eval_maybe_encoded(self.left, ctx)
+            rv = eval_maybe_encoded(self.right, ctx)
+        else:
+            lv, rv = self.left.tpu_eval(ctx), self.right.tpu_eval(ctx)
         if lv.dtype.is_string:
             eq = _string_eq_dev(lv, rv)
         else:

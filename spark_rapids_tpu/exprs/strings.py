@@ -38,6 +38,14 @@ from spark_rapids_tpu.exprs.base import (
 
 
 def string_lengths(v: DevVal):
+    if v.codes is not None:
+        # Dictionary-encoded: offsets describe the ENTRIES; gather per-row
+        # lengths through the codes (invalid rows are length-0, matching the
+        # materialized layout).
+        ent_lens = (v.offsets[1:] - v.offsets[:-1]).astype(jnp.int32)
+        nd = int(v.offsets.shape[0]) - 1
+        codes_c = jnp.clip(v.codes, 0, max(nd - 1, 0))
+        return jnp.where(v.validity, ent_lens[codes_c], 0).astype(jnp.int32)
     return (v.offsets[1:] - v.offsets[:-1]).astype(jnp.int32)
 
 
@@ -73,6 +81,19 @@ def string_hash2(v: DevVal) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Dual 32-bit polynomial row hashes: h = sum byte[i] * base^(end-1-i)
     (mod 2^32).  Equality tests combine both hashes + length (+ the 64-byte
     sort prefix where exactness matters)."""
+    if v.codes is not None:
+        # Dictionary-encoded: hash each ENTRY once (O(dict bytes), not
+        # O(row bytes)) and gather per-row hashes through the codes.
+        # Invalid rows take the empty-string hash (0), exactly as the
+        # materialized layout hashes its length-0 rows.
+        nd_cap = int(v.offsets.shape[0]) - 1
+        ent = DevVal(v.dtype, v.data,
+                     jnp.ones(nd_cap, dtype=jnp.bool_), v.offsets)
+        e1, e2 = string_hash2(ent)
+        codes_c = jnp.clip(v.codes, 0, max(nd_cap - 1, 0))
+        h1 = jnp.where(v.validity, e1[codes_c], jnp.uint32(0))
+        h2 = jnp.where(v.validity, e2[codes_c], jnp.uint32(0))
+        return h1, h2
     cap = v.capacity
     nbytes = int(v.data.shape[0])
     rows = rows_of_positions(v.offsets, nbytes)
@@ -552,9 +573,14 @@ class Like(Expression):
         return None
 
     def tpu_eval(self, ctx) -> DevVal:
-        v = self.children[0].tpu_eval(ctx)
         plan = self._plan()
         kind = plan[0]
+        if kind in ("any", "exact"):
+            # Hash/length-only tests work on dictionary-encoded input.
+            from spark_rapids_tpu.exprs.base import eval_maybe_encoded
+            v = eval_maybe_encoded(self.children[0], ctx)
+        else:
+            v = self.children[0].tpu_eval(ctx)
         lens = string_lengths(v)
         if kind == "any":
             data = jnp.ones(v.capacity, dtype=jnp.bool_)

@@ -8,7 +8,7 @@ instrumented sites, e.g.::
 Grammar (entries joined by ``;``)::
 
     entry    := site ":" kind ["=" duration] "@" n ["+"]
-    site     := dispatch | h2d | d2h | spill | unspill | exchange
+    site     := dispatch | h2d | d2h | spill | unspill | exchange | scan
     kind     := oom | device_lost | slow
     duration := <float> ("ms" | "s")     (slow only; default ms)
     n        := 1-based call index at that site; "+" = that call AND
@@ -25,9 +25,10 @@ Sites are wired where real faults strike: ``instrumented_jit`` dispatch
 (utils.compile_registry), ``host_to_device`` / ``device_to_host_many``
 (batch.py), catalog spill and unspill (mem.catalog — ``spill`` fires on
 the async writer thread and the error surfaces at the consumer's
-``get()``; ``unspill`` fires on the rehydration path) and the shuffle
-exchange split (parallel.exchange).  The disarmed fast path is one
-module-global ``is None`` test per call.
+``get()``; ``unspill`` fires on the rehydration path), the shuffle
+exchange split (parallel.exchange) and the v2 scan's per-chunk decode
+submission (io.scan_v2).  The disarmed fast path is one module-global
+``is None`` test per call.
 """
 
 from __future__ import annotations
@@ -40,7 +41,7 @@ from spark_rapids_tpu.fault import metrics as fault_metrics
 from spark_rapids_tpu.fault.errors import ErrorClass
 from spark_rapids_tpu.obs import events as obs_events
 
-SITES = ("dispatch", "h2d", "d2h", "spill", "unspill", "exchange")
+SITES = ("dispatch", "h2d", "d2h", "spill", "unspill", "exchange", "scan")
 KINDS = ("oom", "device_lost", "slow")
 
 

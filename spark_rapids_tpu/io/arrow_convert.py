@@ -54,8 +54,24 @@ def schema_from_arrow(asch: pa.Schema) -> T.Schema:
     ])
 
 
-def arrow_to_host_batch(table_or_batch, schema: Optional[T.Schema] = None
-                        ) -> HostBatch:
+def _dict_host_column(f: T.Field, arr: "pa.DictionaryArray") -> HostColumn:
+    """Preserve an Arrow dictionary string array as (int64 codes, object
+    dictionary): H2D then moves 4-byte indices per row instead of string
+    bytes, and the dictionary's bytes move once."""
+    validity = np.ones(len(arr), dtype=np.bool_) if arr.null_count == 0 \
+        else np.asarray(arr.is_valid())
+    codes = arr.indices.to_numpy(zero_copy_only=False)
+    codes = np.where(validity, np.nan_to_num(codes), 0).astype(np.int64)
+    entries = np.array(
+        ["" if v is None else v for v in arr.dictionary.to_pylist()],
+        dtype=object)
+    if not len(entries):
+        entries = np.array([""], dtype=object)
+    return HostColumn(f.dtype, codes, validity, entries)
+
+
+def arrow_to_host_batch(table_or_batch, schema: Optional[T.Schema] = None,
+                        keep_dictionary: bool = False) -> HostBatch:
     t0 = time.monotonic_ns()
     tb = table_or_batch
     if isinstance(tb, pa.Table):
@@ -69,6 +85,9 @@ def arrow_to_host_batch(table_or_batch, schema: Optional[T.Schema] = None
             arr = arr.combine_chunks() if arr.num_chunks != 1 else \
                 arr.chunk(0)
         if pa.types.is_dictionary(arr.type):
+            if keep_dictionary and f.dtype.is_string:
+                cols.append(_dict_host_column(f, arr))
+                continue
             arr = arr.dictionary_decode()
         null_free = arr.null_count == 0
         # null-free columns skip the bit-unpacking is_valid() pass

@@ -1,0 +1,66 @@
+"""Process-shared file-decode thread pool.
+
+The v1 scan created a ThreadPoolExecutor per ``partitions()`` call and
+never shut it down — every query (and every serve tenant) leaked
+``multiThreadedRead.numThreads`` threads for the process lifetime.  All
+scans now share ONE pool, grown to the largest thread count any scan has
+requested, with a deterministic bounded shutdown registered at exit (the
+MultiFileReaderThreadPool role, GpuMultiFileReader.scala).
+"""
+
+from __future__ import annotations
+
+import atexit
+import concurrent.futures
+import threading
+from typing import Optional
+
+_lock = threading.Lock()
+_pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
+_pool_size = 0
+
+
+def get_decode_pool(nthreads: int) -> concurrent.futures.ThreadPoolExecutor:
+    """The shared decode pool, grown (never shrunk) to ``nthreads``."""
+    global _pool, _pool_size
+    nthreads = max(1, int(nthreads))
+    with _lock:
+        if _pool is not None and _pool_size >= nthreads:
+            return _pool
+        old = _pool
+        _pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(nthreads, _pool_size),
+            thread_name_prefix="rapids-decode")
+        _pool_size = max(nthreads, _pool_size)
+    if old is not None:
+        _shutdown(old)
+    return _pool
+
+
+def decode_pool_size() -> int:
+    """Current worker count (0 when no pool has been created)."""
+    with _lock:
+        return _pool_size if _pool is not None else 0
+
+
+def _shutdown(pool: concurrent.futures.ThreadPoolExecutor,
+              timeout: float = 5.0) -> None:
+    # shutdown(wait=True) joins without a bound; reap each worker with a
+    # per-thread timeout instead so a wedged decode can't hang exit.
+    pool.shutdown(wait=False)
+    for t in list(getattr(pool, "_threads", ())):
+        t.join(timeout=timeout)
+
+
+def shutdown_decode_pool(timeout: float = 5.0) -> None:
+    """Deterministically stop the shared pool (idempotent; tests + atexit)."""
+    global _pool, _pool_size
+    with _lock:
+        pool = _pool
+        _pool = None
+        _pool_size = 0
+    if pool is not None:
+        _shutdown(pool, timeout)
+
+
+atexit.register(shutdown_decode_pool)

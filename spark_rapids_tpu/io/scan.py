@@ -13,7 +13,6 @@ prune whole files (PartitioningAwareFileIndex pruning role).
 
 from __future__ import annotations
 
-import concurrent.futures
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -146,8 +145,13 @@ def _read_orc_file(path: str, columns: List[str], batch_rows: int,
     import pyarrow.orc as orc
     f = orc.ORCFile(path)
     n_stripes = f.nstripes
+    # Probe predicate columns independently of the projection: a filter on
+    # a non-projected column must still drive stripe skipping (intersect
+    # only with what the FILE actually has — partition-value predicates
+    # have no file column to probe).
+    avail = set(f.schema.names)
     pred_cols = sorted({name for name, _op, _v in (descriptors or [])
-                        if name in (columns or [])})
+                        if name in avail})
     keep: List[int] = []
     for i in range(n_stripes):
         if not descriptors or not pred_cols:
@@ -191,6 +195,29 @@ def _read_orc_file(path: str, columns: List[str], batch_rows: int,
         for j in range(0, hb.num_rows, batch_rows):
             out.append(hb.slice(j, min(batch_rows, hb.num_rows - j)))
     return out
+
+
+def partition_value_column(f: T.Field, v: Any, n: int,
+                           use_dict: bool = False) -> HostColumn:
+    """Constant partition-value column for one file's batches
+    (ColumnarPartitionReaderWithPartitionValues role).  With ``use_dict``
+    a string value becomes a 1-entry dictionary column — H2D then moves
+    int32 codes instead of ``n`` copies of the same bytes."""
+    if v is None:
+        values = np.zeros(n, dtype=object if f.dtype.is_string
+                          else f.dtype.np_dtype)
+        validity = np.zeros(n, dtype=np.bool_)
+        if use_dict and f.dtype.is_string:
+            return HostColumn(f.dtype, np.zeros(n, dtype=np.int64), validity,
+                              np.array([""], dtype=object))
+        return HostColumn(f.dtype, values, validity)
+    validity = np.ones(n, dtype=np.bool_)
+    if use_dict and f.dtype.is_string:
+        return HostColumn(f.dtype, np.zeros(n, dtype=np.int64), validity,
+                          np.array([str(v)], dtype=object))
+    values = np.full(n, v, dtype=object if f.dtype.is_string
+                     else f.dtype.np_dtype)
+    return HostColumn(f.dtype, values, validity)
 
 
 def _read_csv_file(path: str, columns: List[str], batch_rows: int,
@@ -284,10 +311,15 @@ class CpuFileScanExec(CpuExec):
             batches = _read_csv_file(path, columns, batch_rows, self.options)
         else:
             raise ValueError(self.fmt)
+        return self._with_partition_columns(path, batches)
+
+    def _with_partition_columns(self, path: str, batches: List[HostBatch],
+                                use_dict: bool = False) -> List[HostBatch]:
+        """Append this file's constant partition-value columns and reorder
+        to the output schema (ColumnarPartitionReaderWithPartitionValues
+        role)."""
         if self.partitions_info is None or not batches:
             return batches
-        # append this file's constant partition-value columns
-        # (ColumnarPartitionReaderWithPartitionValues role)
         _part_schema, file_values = self.partitions_info
         vals = dict(zip(_part_schema.names, file_values[path]))
         out = []
@@ -298,19 +330,8 @@ class CpuFileScanExec(CpuExec):
                 if f.name in cols:
                     ordered.append(cols[f.name])
                 else:
-                    v = vals[f.name]
-                    n = hb.num_rows
-                    if v is None:
-                        values = np.zeros(n, dtype=object
-                                          if f.dtype.is_string
-                                          else f.dtype.np_dtype)
-                        validity = np.zeros(n, dtype=np.bool_)
-                    else:
-                        values = np.full(
-                            n, v, dtype=object if f.dtype.is_string
-                            else f.dtype.np_dtype)
-                        validity = np.ones(n, dtype=np.bool_)
-                    ordered.append(HostColumn(f.dtype, values, validity))
+                    ordered.append(partition_value_column(
+                        f, vals[f.name], hb.num_rows, use_dict))
             out.append(HostBatch(self.output_schema, ordered))
         return out
 
@@ -319,8 +340,8 @@ class CpuFileScanExec(CpuExec):
         groups: List[List[str]] = [[] for _ in range(n)]
         for i, p in enumerate(self.paths):
             groups[i % n].append(p)
-        pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=self._nthreads)
+        from spark_rapids_tpu.io.decode_pool import get_decode_pool
+        pool = get_decode_pool(self._nthreads)
         rg_read = ctx.metric(self.op_id, "rowGroupsRead")
         rg_total = ctx.metric(self.op_id, "rowGroupsTotal")
 

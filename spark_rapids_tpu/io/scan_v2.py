@@ -1,0 +1,393 @@
+"""Scan v2: chunk-granular parallel decode with bounded read-ahead,
+dictionary-preserving string decode and chunk-level late materialization
+(docs/io.md; the MultiFileParquetPartitionReader shape,
+GpuParquetScan.scala:647-700, rebuilt for the host-decode TPU pipeline).
+
+v1 decodes whole files serially on one pool thread per file and
+materializes every HostBatch before the first H2D transfer.  v2 splits the
+decode at parquet row-group / ORC stripe granularity, runs chunks on the
+process-shared decode pool (io.decode_pool) and yields them through an
+ordered sliding window of ``scan.readAhead.depth`` in-flight futures — so
+decode of chunks k+1..k+depth overlaps the consumer's H2D staging and
+device compute of chunk k, while output order stays deterministic
+(submission order, for bit parity with v1).
+
+Late materialization (``scan.lateMaterialization.enabled``): when
+conjuncts were pushed, each chunk first decodes ONLY the predicate
+columns present in the file and evaluates the conjuncts exactly; chunks
+with no surviving row skip the decode of every remaining projected
+column.  The Filter above the scan re-applies the predicate, so the skip
+is chunk-granular and bit-exact.
+
+Dictionary encoding (``scan.dictEncoding.enabled``): when the consumer is
+H2D staging (HostToDeviceExec's ``set_device_consumer`` handshake),
+parquet string columns are decoded with Arrow dictionary preservation and
+emitted as (codes, dictionary) HostColumns — the transfer moves integer
+codes per row plus the dictionary's bytes once, and device kernels that
+only need lengths/hashes/prefixes (string equality, group keys) never
+touch the raw bytes (exprs.strings / kernels.sortkeys dict paths).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Callable, Iterable, List, Optional
+
+import numpy as np
+
+from spark_rapids_tpu.batch import HostBatch, host_batch_bytes
+from spark_rapids_tpu.config import (
+    SCAN_DICT_ENCODING_ENABLED, SCAN_LATE_MAT_ENABLED, SCAN_READAHEAD_DEPTH,
+    RapidsConf,
+)
+from spark_rapids_tpu.fault import inject
+from spark_rapids_tpu.io.arrow_convert import arrow_to_host_batch
+from spark_rapids_tpu.io.decode_pool import get_decode_pool
+from spark_rapids_tpu.io.discovery import csv_options
+from spark_rapids_tpu.io.scan import CpuFileScanExec, _row_group_can_match
+from spark_rapids_tpu.obs import events as obs_events
+from spark_rapids_tpu.plan.physical import ExecContext
+
+
+@dataclasses.dataclass
+class _ChunkResult:
+    """One decoded (or skipped) chunk, in submission order."""
+
+    batches: List[HostBatch]
+    decode_ns: int = 0
+    bytes_decoded: int = 0
+    skipped: bool = False       # late-mat: no row can survive the conjuncts
+    rg_total: int = 0
+    rg_read: int = 0
+    dict_columns: int = 0
+    label: str = ""
+    t0: int = 0                 # worker-side decode window (monotonic ns)
+    t1: int = 0
+
+
+def _chunk_survivors(descriptors, table) -> bool:
+    """Exact chunk-level survival: does ANY row satisfy every pushed
+    conjunct?  Evaluated with plain numpy comparisons — the same IEEE
+    semantics the device Filter applies — so a skipped chunk can never
+    contain a row the Filter would have kept."""
+    import pyarrow as pa
+    mask: Optional[np.ndarray] = None
+    for name, op, value in descriptors:
+        if name not in table.schema.names:
+            continue
+        arr = table.column(name)
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks() if arr.num_chunks != 1 else \
+                arr.chunk(0)
+        if pa.types.is_dictionary(arr.type):
+            arr = arr.dictionary_decode()
+        valid = np.ones(len(arr), dtype=np.bool_) if arr.null_count == 0 \
+            else np.asarray(arr.is_valid())
+        if op == "notnull":
+            m = valid
+        else:
+            try:
+                if pa.types.is_string(arr.type) or \
+                        pa.types.is_large_string(arr.type):
+                    vals = np.array(
+                        ["" if v is None else v for v in arr.to_pylist()],
+                        dtype=object)
+                else:
+                    vals = arr.to_numpy(zero_copy_only=False)
+                cmp = {"eq": np.equal, "lt": np.less, "le": np.less_equal,
+                       "gt": np.greater, "ge": np.greater_equal}[op]
+                with np.errstate(invalid="ignore"):
+                    m = valid & np.asarray(cmp(vals, value), dtype=np.bool_)
+            except (TypeError, ValueError):
+                continue  # incomparable: conservatively keep the chunk
+        mask = m if mask is None else (mask & m)
+    return bool(mask.any()) if mask is not None else True
+
+
+class FileScanV2Exec(CpuFileScanExec):
+    """Chunk-parallel scan with read-ahead, dictionary strings and late
+    materialization; bit-parity with :class:`CpuFileScanExec`."""
+
+    def __init__(self, node, conf: RapidsConf):
+        super().__init__(node, conf)
+        self._depth = max(1, SCAN_READAHEAD_DEPTH.get(conf))
+        self._dict_enabled = SCAN_DICT_ENCODING_ENABLED.get(conf)
+        self._late_mat = SCAN_LATE_MAT_ENABLED.get(conf)
+        self._device_consumer = False
+
+    def set_device_consumer(self) -> None:
+        """Called by HostToDeviceExec: batches feed device staging, so
+        dictionary-encoded string columns may be emitted."""
+        self._device_consumer = True
+
+    def _use_dict(self) -> bool:
+        return self._device_consumer and self._dict_enabled
+
+    def describe(self):
+        flags = []
+        if self.descriptors:
+            flags.append(f"pushed={len(self.descriptors)}")
+        if self._use_dict():
+            flags.append("dict")
+        if self._late_mat:
+            flags.append("latemat")
+        extra = (", " + ",".join(flags)) if flags else ""
+        return (f"FileScanV2({self.fmt}, {len(self.paths)} files, "
+                f"depth={self._depth}{extra})")
+
+    # -- chunk planning ------------------------------------------------------
+
+    def _file_columns(self) -> List[str]:
+        part_fields = []
+        if self.partitions_info is not None:
+            part_fields = self.partitions_info[0].fields
+        part_names = {f.name for f in part_fields}
+        return [n for n in self.output_schema.names if n not in part_names]
+
+    def _chunk_tasks(self, files: List[str]
+                     ) -> Iterable[Callable[[], _ChunkResult]]:
+        """Lazily yield one decode task per chunk, in deterministic order
+        (file order, then chunk index) — the sliding window preserves it."""
+        columns = self._file_columns()
+        batch_rows = self.conf.max_readers_batch_size_rows
+        for path in files:
+            if self.fmt == "parquet":
+                import pyarrow.parquet as pq
+                n_rg = pq.ParquetFile(path).metadata.num_row_groups
+                for rg in range(n_rg):
+                    yield (lambda p=path, i=rg:
+                           self._decode_parquet_chunk(p, i, columns,
+                                                      batch_rows))
+            elif self.fmt == "orc":
+                import pyarrow.orc as orc
+                n_stripes = orc.ORCFile(path).nstripes
+                for st in range(n_stripes):
+                    yield (lambda p=path, i=st:
+                           self._decode_orc_chunk(p, i, columns, batch_rows))
+            elif self.fmt == "csv":
+                yield (lambda p=path:
+                       self._decode_csv_chunk(p, columns, batch_rows))
+            else:
+                raise ValueError(self.fmt)
+
+    # -- per-chunk decode (runs on pool worker threads) ----------------------
+
+    def _finish_chunk(self, path: str, batches: List[HostBatch],
+                      res: _ChunkResult) -> _ChunkResult:
+        use_dict = self._use_dict()
+        batches = self._with_partition_columns(path, batches,
+                                               use_dict=use_dict)
+        res.batches = batches
+        res.bytes_decoded = sum(host_batch_bytes(hb) for hb in batches)
+        if use_dict:
+            res.dict_columns = sum(
+                1 for hb in batches[:1] for c in hb.columns
+                if c.dictionary is not None)
+        return res
+
+    def _decode_parquet_chunk(self, path: str, rg: int, columns: List[str],
+                              batch_rows: int) -> _ChunkResult:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        res = _ChunkResult([], rg_total=1, label=f"parquet:{rg}",
+                           t0=time.monotonic_ns())
+        # each task opens its own reader: ParquetFile is not safe for
+        # concurrent reads from multiple pool threads
+        f = pq.ParquetFile(path)
+        file_schema = f.schema_arrow
+        read_dict: List[str] = []
+        if self._use_dict():
+            read_dict = [
+                n for n in file_schema.names
+                if (pa.types.is_string(file_schema.field(n).type) or
+                    pa.types.is_large_string(file_schema.field(n).type))]
+            if read_dict:
+                f = pq.ParquetFile(path, read_dictionary=read_dict)
+        meta = f.metadata
+        col_index = {meta.schema.column(i).name: i
+                     for i in range(meta.num_columns)}
+        if self.descriptors and not _row_group_can_match(
+                meta.row_group(rg), col_index, self.descriptors):
+            res.t1 = time.monotonic_ns()
+            res.decode_ns = res.t1 - res.t0
+            return res  # statistics skip (v1 parity): nothing decoded
+        res.rg_read = 1
+        probe = None
+        if self._late_mat and self.descriptors:
+            pred_cols = sorted({name for name, _op, _v in self.descriptors
+                                if name in file_schema.names})
+            if pred_cols:
+                probe = f.read_row_group(rg, columns=pred_cols)
+                if not _chunk_survivors(self.descriptors, probe):
+                    res.skipped = True
+                    res.bytes_decoded = probe.nbytes
+                    res.t1 = time.monotonic_ns()
+                    res.decode_ns = res.t1 - res.t0
+                    return res
+        if not columns:
+            tb = f.read_row_group(rg)  # v1 parity: empty projection -> all
+        elif probe is None:
+            tb = f.read_row_group(rg, columns=columns)
+        else:
+            # survivors exist: decode only the columns the probe didn't
+            rest = [c for c in columns if c not in probe.schema.names]
+            tb_rest = f.read_row_group(rg, columns=rest) if rest else None
+            arrays = {}
+            for src in (probe, tb_rest):
+                if src is not None:
+                    for name in src.schema.names:
+                        arrays[name] = src.column(name)
+            tb = pa.table({n: arrays[n] for n in columns})
+        hb = arrow_to_host_batch(tb, keep_dictionary=bool(read_dict))
+        batches = [hb.slice(j, min(batch_rows, hb.num_rows - j))
+                   for j in range(0, hb.num_rows, batch_rows)]
+        self._finish_chunk(path, batches, res)
+        res.t1 = time.monotonic_ns()
+        res.decode_ns = res.t1 - res.t0
+        return res
+
+    def _decode_orc_chunk(self, path: str, stripe: int, columns: List[str],
+                          batch_rows: int) -> _ChunkResult:
+        import pyarrow.orc as orc
+        res = _ChunkResult([], rg_total=1, label=f"orc:{stripe}",
+                           t0=time.monotonic_ns())
+        f = orc.ORCFile(path)
+        avail = set(f.schema.names)
+        pred_cols = sorted({name for name, _op, _v in self.descriptors
+                            if name in avail})
+        if pred_cols:
+            probe = f.read_stripe(stripe, columns=pred_cols)
+            if self._late_mat:
+                if not _chunk_survivors(self.descriptors, probe):
+                    res.skipped = True
+                    res.bytes_decoded = probe.nbytes
+                    res.t1 = time.monotonic_ns()
+                    res.decode_ns = res.t1 - res.t0
+                    return res
+            elif not self._stripe_can_match(probe):
+                res.t1 = time.monotonic_ns()
+                res.decode_ns = res.t1 - res.t0
+                return res  # v1-style min/max stripe skip
+        res.rg_read = 1
+        hb = arrow_to_host_batch(f.read_stripe(stripe,
+                                               columns=columns or None))
+        batches = [hb.slice(j, min(batch_rows, hb.num_rows - j))
+                   for j in range(0, hb.num_rows, batch_rows)]
+        self._finish_chunk(path, batches, res)
+        res.t1 = time.monotonic_ns()
+        res.decode_ns = res.t1 - res.t0
+        return res
+
+    def _stripe_can_match(self, probe) -> bool:
+        """v1 ORC min/max stripe test over probe columns (same NaN
+        conservatism as io.scan._read_orc_file)."""
+        from spark_rapids_tpu.io.scan import _range_can_match
+        for name, op, value in self.descriptors:
+            if name not in probe.schema.names:
+                continue
+            arr = probe.column(name)
+            nulls = arr.null_count
+            if op == "notnull":
+                if nulls == len(arr):
+                    return False
+                continue
+            if nulls == len(arr):
+                return False  # all NULL: no comparison can hold
+            vals = arr.drop_null().to_numpy(zero_copy_only=False)
+            if vals.dtype.kind == "f" and np.isnan(vals).any():
+                continue  # NaN poisons min/max; never skip such stripes
+            if not _range_can_match(op, value, vals.min(), vals.max()):
+                return False
+        return True
+
+    def _decode_csv_chunk(self, path: str, columns: List[str],
+                          batch_rows: int) -> _ChunkResult:
+        import pyarrow.csv as pacsv
+        res = _ChunkResult([], rg_total=1, rg_read=1, label="csv",
+                           t0=time.monotonic_ns())
+        read_opts, parse_opts, conv_opts = csv_options(self.options)
+        if columns:
+            conv_opts.include_columns = columns
+        tb = pacsv.read_csv(path, read_options=read_opts,
+                            parse_options=parse_opts,
+                            convert_options=conv_opts)
+        hb = arrow_to_host_batch(tb)
+        batches = [hb.slice(j, min(batch_rows, hb.num_rows - j))
+                   for j in range(0, hb.num_rows, batch_rows)] \
+            if hb.num_rows else []
+        self._finish_chunk(path, batches, res)
+        res.t1 = time.monotonic_ns()
+        res.decode_ns = res.t1 - res.t0
+        return res
+
+    # -- partition driver ----------------------------------------------------
+
+    def partitions(self, ctx: ExecContext):
+        n = self.num_partitions(ctx)
+        groups: List[List[str]] = [[] for _ in range(n)]
+        for i, p in enumerate(self.paths):
+            groups[i % n].append(p)
+        pool = get_decode_pool(self._nthreads)
+        m_decode = ctx.metric(self.op_id, "scanDecodeWallNs")
+        m_overlap = ctx.metric(self.op_id, "scanH2dOverlapNs")
+        m_bytes = ctx.metric(self.op_id, "scanBytesDecoded")
+        m_dict = ctx.metric(self.op_id, "scanDictColumns")
+        m_skipped = ctx.metric(self.op_id, "scanChunksSkipped")
+        rg_read = ctx.metric(self.op_id, "rowGroupsRead")
+        rg_total = ctx.metric(self.op_id, "rowGroupsTotal")
+        depth = self._depth
+
+        def gen(files: List[str]):
+            pending: collections.deque = collections.deque()
+            stats = {"decode": 0, "bytes": 0, "skipped": 0, "dict": 0,
+                     "rg_read": 0, "rg_total": 0, "blocked": 0}
+
+            def drain_one() -> _ChunkResult:
+                fu = pending.popleft()
+                w0 = time.monotonic_ns()
+                res = fu.result()
+                stats["blocked"] += time.monotonic_ns() - w0
+                stats["decode"] += res.decode_ns
+                stats["bytes"] += res.bytes_decoded
+                stats["skipped"] += 1 if res.skipped else 0
+                stats["dict"] += res.dict_columns
+                stats["rg_read"] += res.rg_read
+                stats["rg_total"] += res.rg_total
+                obs_events.emit_span(
+                    "scan", "chunk", op_id=self.op_id, t0=res.t0, t1=res.t1,
+                    label=res.label, bytes=res.bytes_decoded,
+                    skipped=res.skipped)
+                return res
+
+            def results():
+                for task in self._chunk_tasks(files):
+                    # fire on the consumer thread: deterministic per-query
+                    # numbering AND the active query's scoped registry
+                    # (pool workers carry no obs scope)
+                    inject.maybe_fire("scan")
+                    pending.append(pool.submit(task))
+                    while len(pending) >= depth:
+                        yield drain_one()
+                while pending:
+                    yield drain_one()
+
+            try:
+                for res in results():
+                    for hb in res.batches:
+                        if hb.num_rows:
+                            yield hb
+            finally:
+                for fu in pending:
+                    fu.cancel()
+                pending.clear()
+                m_decode.add(stats["decode"])
+                m_overlap.add(max(0, stats["decode"] - stats["blocked"]))
+                m_bytes.add(stats["bytes"])
+                m_dict.add(stats["dict"])
+                m_skipped.add(stats["skipped"])
+                rg_read.add(stats["rg_read"])
+                rg_total.add(stats["rg_total"])
+
+        return [gen(g) for g in groups]

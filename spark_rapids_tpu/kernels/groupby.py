@@ -18,7 +18,9 @@ import jax.numpy as jnp
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.batch import ColumnBatch, DeviceColumn
 from spark_rapids_tpu.exprs.base import DevVal
-from spark_rapids_tpu.kernels.layout import compaction_indices, gather_rows
+from spark_rapids_tpu.kernels.layout import (
+    compaction_indices, ensure_row_layout, gather_rows,
+)
 from spark_rapids_tpu.kernels.sort import argsort_batch
 from spark_rapids_tpu.kernels.sortkeys import keys_equal_prev
 
@@ -43,11 +45,19 @@ def group_segments(key_vals: List[DevVal], num_rows) -> GroupSegments:
     live = jnp.arange(cap, dtype=jnp.int32) < num_rows
     # Reorder key columns by the permutation; strings need real byte gathers
     # for the adjacent-equality check (cheap relative to the sort itself).
-    sorted_keys = [
-        _gather_str_val(v, perm, cap) if v.dtype.is_string
-        else DevVal(v.dtype, v.data[perm], v.validity[perm])
-        for v in key_vals
-    ]
+    # Dictionary-encoded strings just permute their codes — the entry
+    # buffer is row-order independent, so no byte gather is needed.
+    sorted_keys = []
+    for v in key_vals:
+        if v.codes is not None:
+            sorted_keys.append(DevVal(v.dtype, v.data, v.validity[perm],
+                                      v.offsets, v.codes[perm],
+                                      v.mat_byte_cap))
+        elif v.dtype.is_string:
+            sorted_keys.append(_gather_str_val(v, perm, cap))
+        else:
+            sorted_keys.append(DevVal(v.dtype, v.data[perm],
+                                      v.validity[perm]))
     eq_prev = keys_equal_prev(sorted_keys)
     seg_start = live & ~eq_prev
     seg_ids = jnp.clip(jnp.cumsum(seg_start.astype(jnp.int32)) - 1, 0, cap - 1)
@@ -72,9 +82,13 @@ def groupby_aggregate(batch: ColumnBatch, key_vals: List[DevVal],
     segs = group_segments(key_vals, batch.num_rows)
 
     # Representative key rows: compact sorted rows where seg_start.
-    key_cols = [DeviceColumn(v.dtype, v.data, v.validity, v.offsets)
+    # Encoded key columns materialize here — downstream (merge rounds,
+    # concat, output) only ever sees the row layout.
+    key_cols = [DeviceColumn(v.dtype, v.data, v.validity, v.offsets,
+                             v.codes, v.mat_byte_cap)
                 for v in key_vals]
-    key_batch = ColumnBatch(key_schema, key_cols, batch.num_rows, cap)
+    key_batch = ensure_row_layout(
+        ColumnBatch(key_schema, key_cols, batch.num_rows, cap))
     sorted_keys = gather_rows(key_batch, segs.perm, batch.num_rows)
     idx, count = compaction_indices(segs.seg_start, jnp.asarray(cap, jnp.int32))
     group_keys = gather_rows(sorted_keys, idx, segs.num_groups)
@@ -94,8 +108,16 @@ def groupby_aggregate(batch: ColumnBatch, key_vals: List[DevVal],
                                                 segs.live))
     else:
         for fn, v in zip(agg_fns, agg_inputs):
-            sv = DevVal(v.dtype, v.data[segs.perm], v.validity[segs.perm]) \
-                if not v.dtype.is_string else _gather_str_val(v, segs.perm, cap)
+            if v.codes is not None:
+                # encoded input (Count over a dict string): permute codes,
+                # entries are row-order independent
+                sv = DevVal(v.dtype, v.data, v.validity[segs.perm],
+                            v.offsets, v.codes[segs.perm], v.mat_byte_cap)
+            elif v.dtype.is_string:
+                sv = _gather_str_val(v, segs.perm, cap)
+            else:
+                sv = DevVal(v.dtype, v.data[segs.perm],
+                            v.validity[segs.perm])
             out_buffers.append(fn.segment_update(sv, segs.seg_ids, cap,
                                                  segs.live))
     return group_keys, out_buffers

@@ -67,6 +67,7 @@ def gather_rows(batch: ColumnBatch, indices, num_rows,
     string column (defaults to the input column's byte capacity — valid
     whenever the gather cannot grow total bytes, e.g. permutations/filters).
     """
+    batch = ensure_row_layout(batch)
     out_cap = out_capacity if out_capacity is not None else batch.capacity
     live = jnp.arange(out_cap, dtype=jnp.int32) < num_rows
     indices = jnp.clip(indices.astype(jnp.int32), 0, batch.capacity - 1)
@@ -85,6 +86,49 @@ def gather_rows(batch: ColumnBatch, indices, num_rows,
             cols.append(DeviceColumn(col.dtype, data, validity, None))
     return ColumnBatch(batch.schema, cols, jnp.asarray(num_rows, jnp.int32),
                        out_cap)
+
+
+def dict_decode_column(col: DeviceColumn) -> DeviceColumn:
+    """Materialize a dictionary-encoded string column to plain row layout.
+
+    The column's data/offsets describe the dictionary ENTRIES; ``codes``
+    maps rows to entries and ``mat_byte_cap`` is the static byte bucket
+    the materialized bytes fit in (computed at staging from the live
+    codes).  Output matches what staging the decoded values would have
+    produced: invalid/dead rows contribute zero bytes, offsets constant
+    past the live region.  Safe inside ``jax.jit``.
+    """
+    assert col.codes is not None
+    cap = int(col.codes.shape[0])
+    nd = int(col.offsets.shape[0]) - 1
+    ent_lens = (col.offsets[1:] - col.offsets[:-1]).astype(jnp.int32)
+    codes_c = jnp.clip(col.codes, 0, max(nd - 1, 0))
+    lens = jnp.where(col.validity, ent_lens[codes_c], 0)
+    new_offsets = jnp.concatenate([
+        jnp.zeros(1, dtype=jnp.int32),
+        jnp.cumsum(lens).astype(jnp.int32),
+    ])
+    bcap = col.mat_byte_cap if col.mat_byte_cap > 0 else int(col.data.shape[0])
+    rows = _rows_of_positions(new_offsets, bcap)
+    rows_c = jnp.clip(rows, 0, cap - 1)
+    pos_in_row = jnp.arange(bcap, dtype=jnp.int32) - new_offsets[rows_c]
+    src_pos = col.offsets[codes_c[rows_c]] + pos_in_row
+    src_pos = jnp.clip(src_pos, 0, int(col.data.shape[0]) - 1)
+    in_range = jnp.arange(bcap, dtype=jnp.int32) < new_offsets[-1]
+    data = jnp.where(in_range, col.data[src_pos], 0).astype(col.data.dtype)
+    return DeviceColumn(col.dtype, data, col.validity, new_offsets)
+
+
+def ensure_row_layout(batch: ColumnBatch) -> ColumnBatch:
+    """Materialize any dictionary-encoded columns of ``batch`` to plain
+    row layout.  Python-level no-op (returns the same object) when none
+    are encoded, so it is free at every exec entry; the decode itself is
+    traceable and safe inside ``jax.jit``."""
+    if not any(c.codes is not None for c in batch.columns):
+        return batch
+    cols = [dict_decode_column(c) if c.codes is not None else c
+            for c in batch.columns]
+    return ColumnBatch(batch.schema, cols, batch.num_rows, batch.capacity)
 
 
 def row_slices(batch: ColumnBatch, total_rows: int, rows_per: int):
@@ -165,6 +209,7 @@ def concat_kway(batches: Sequence[ColumnBatch], out_capacity: int,
     capacities, matching the chain's accumulated default.
     """
     assert batches
+    batches = [ensure_row_layout(b) for b in batches]
     if len(batches) == 1:
         return batches[0]
     schema = batches[0].schema
@@ -278,6 +323,7 @@ def gather_segments_kway(batches: Sequence[ColumnBatch], starts, counts,
     construction; see concat_kway's live-bytes note).
     """
     assert batches
+    batches = [ensure_row_layout(b) for b in batches]
     schema = batches[0].schema
     for b in batches[1:]:
         assert b.schema == schema, f"{b.schema} != {schema}"
@@ -378,6 +424,7 @@ def concat_pair(a: ColumnBatch, b: ColumnBatch, out_capacity: int,
     is NOT required — only >= total live rows (host guarantees via sizing).
     """
     assert a.schema == b.schema, f"{a.schema} != {b.schema}"
+    a, b = ensure_row_layout(a), ensure_row_layout(b)
     n_a = a.num_rows
     total = a.num_rows + b.num_rows
     live = jnp.arange(out_capacity, dtype=jnp.int32) < total

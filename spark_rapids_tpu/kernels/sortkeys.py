@@ -145,6 +145,14 @@ def string_prefix_words(col_or_val, prefix_bytes: int) -> List[jnp.ndarray]:
     """Big-endian packed u32 words of each row's first ``prefix_bytes``
     bytes."""
     v = col_or_val
+    if getattr(v, "codes", None) is not None:
+        # Dictionary-encoded: pack each ENTRY's prefix once, gather per row.
+        nd = int(v.offsets.shape[0]) - 1
+        ent = DevVal(v.dtype, v.data, jnp.ones(nd, dtype=jnp.bool_),
+                     v.offsets)
+        codes_c = jnp.clip(v.codes, 0, max(nd - 1, 0))
+        return [jnp.where(v.validity, w[codes_c], jnp.uint32(0))
+                for w in string_prefix_words(ent, prefix_bytes)]
     offsets, data = v.offsets, v.data
     cap = int(offsets.shape[0]) - 1
     nbytes = int(data.shape[0])
@@ -201,8 +209,10 @@ def encode_sort_keys(vals: List[DevVal], ascendings: List[bool],
             # keys_equal_prev) never split one group across a run of
             # prefix-equal strings.  Beyond-prefix *order* between unequal
             # strings remains approximate (documented).
-            from spark_rapids_tpu.exprs.strings import string_hash2
-            lens = (v.offsets[1:] - v.offsets[:-1]).astype(jnp.uint32)
+            from spark_rapids_tpu.exprs.strings import (
+                string_hash2, string_lengths,
+            )
+            lens = string_lengths(v).astype(jnp.uint32)
             h1, h2 = string_hash2(v)
             tail = [lens, h1.astype(jnp.uint32), h2.astype(jnp.uint32)]
             if grp:
@@ -272,8 +282,10 @@ def keys_equal_prev(vals: List[DevVal]) -> jnp.ndarray:
     for v in vals:
         eq = eq & ~shift_ne(v.validity)
         if v.dtype.is_string:
-            from spark_rapids_tpu.exprs.strings import string_hash2
-            lens = (v.offsets[1:] - v.offsets[:-1]).astype(jnp.int32)
+            from spark_rapids_tpu.exprs.strings import (
+                string_hash2, string_lengths,
+            )
+            lens = string_lengths(v)
             h1, h2 = string_hash2(v)
             cmp_words = [lens, h1, h2] + string_prefix_words(
                 v, DEFAULT_STRING_PREFIX_BYTES)

@@ -86,6 +86,8 @@ def device_batch_bytes(batch: ColumnBatch) -> int:
         total += int(c.validity.size)
         if c.offsets is not None:
             total += 4 * int(c.offsets.size)
+        if c.codes is not None:
+            total += 4 * int(c.codes.size)
     return total
 
 
@@ -601,13 +603,26 @@ class BufferCatalog:
     def drain_spills(self) -> None:
         """Join every in-flight async spill (tests, bench, shutdown
         barriers).  Queued tasks run to completion; the wait is bounded
-        per slice (watchdog-compatible)."""
+        per slice (watchdog-compatible).
+
+        A writer thread clears its D2H task *before* it runs host-budget
+        enforcement, so "no tasks visible" does not yet mean the host
+        store fits: the host->disk push may not have started.  The host
+        bytes ARE counted by then, so running enforcement here closes
+        that window — it either does the push itself or loses the victim
+        pick to the writer's concurrent loop, and the re-check below
+        waits out whichever task that created."""
         while True:
             with self._lock:
                 tasks = [h._spill_task for h in self._handles.values()
                          if h._spill_task is not None]
             if not tasks:
-                return
+                self._enforce_host_budget()
+                with self._lock:
+                    tasks = [h._spill_task for h in self._handles.values()
+                             if h._spill_task is not None]
+                if not tasks:
+                    return
             for t in tasks:
                 t.wait_done()
 

@@ -656,11 +656,27 @@ class TpuHashAggregateExec(TpuExec):
 
     def _eval_keys(self, batch) -> List[DevVal]:
         if self.mode == "update":
+            # String group keys stay dictionary-encoded when the scan
+            # delivered them that way: the sort-based grouping only needs
+            # lengths/hashes/prefixes, all of which gather through the
+            # codes, so the dictionary is hashed once instead of per row.
+            from spark_rapids_tpu.exprs.base import eval_maybe_encoded
             ctx = TpuEvalCtx(batch)
-            return [e.tpu_eval(ctx) for e in self.key_exprs]
+            return [eval_maybe_encoded(e, ctx) if e.dtype.is_string
+                    else e.tpu_eval(ctx) for e in self.key_exprs]
         # merge mode: keys are the leading child columns by position
         return [DevVal.from_column(batch.columns[i])
                 for i in range(len(self.key_exprs))]
+
+    @staticmethod
+    def _eval_agg_input(fn, ctx) -> DevVal:
+        # Count consumes only validity, so a dictionary-encoded string
+        # child stays encoded — no byte materialization just to count rows
+        from spark_rapids_tpu.exprs.aggregates import Count
+        from spark_rapids_tpu.exprs.base import eval_maybe_encoded
+        if type(fn) is Count and fn.child.dtype.is_string:
+            return eval_maybe_encoded(fn.child, ctx)
+        return fn.child.tpu_eval(ctx)
 
     def _synth_key(self, batch) -> List[DevVal]:
         """Zero grouping keys (global reduction): constant key, one group."""
@@ -677,7 +693,8 @@ class TpuHashAggregateExec(TpuExec):
 
         if self.mode == "update":
             ctx = TpuEvalCtx(batch)
-            agg_inputs = [a.fn.child.tpu_eval(ctx) for a in self.aggs]
+            agg_inputs = [self._eval_agg_input(a.fn, ctx)
+                          for a in self.aggs]
             merge = False
         else:
             nk = len(self.key_exprs) if not keyless else 0
@@ -727,7 +744,7 @@ class TpuHashAggregateExec(TpuExec):
         key_schema = T.Schema([("__k", T.INT)]) if keyless else \
             self.key_schema
         ctx = TpuEvalCtx(batch)
-        agg_inputs = [a.fn.child.tpu_eval(ctx) for a in self.aggs]
+        agg_inputs = [self._eval_agg_input(a.fn, ctx) for a in self.aggs]
         group_keys, buffers, num_groups, collided = hash_group_aggregate(
             batch, key_vals, agg_inputs, [a.fn for a in self.aggs],
             key_schema, self.output_schema, table=self._mxu_table)

@@ -245,6 +245,10 @@ class TpuOverrides:
             return C.CpuInMemoryScanExec(node.batches, node.schema,
                                          node.num_partitions)
         if isinstance(node, L.FileScan):
+            from spark_rapids_tpu.config import SCAN_V2_ENABLED
+            if SCAN_V2_ENABLED.get(self.conf):
+                from spark_rapids_tpu.io.scan_v2 import FileScanV2Exec
+                return FileScanV2Exec(node, self.conf)
             from spark_rapids_tpu.io.scan import CpuFileScanExec
             return CpuFileScanExec(node, self.conf)
         if isinstance(node, L.BroadcastHint):

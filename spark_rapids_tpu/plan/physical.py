@@ -231,6 +231,13 @@ class HostToDeviceExec(TpuExec):
 
     def __init__(self, child: PhysicalOp):
         super().__init__([child], child.output_schema)
+        # Device-consumer handshake: a scan that can emit dictionary-encoded
+        # string columns only does so when its batches are headed for H2D
+        # staging (codes transfer instead of bytes); CPU-exec consumers
+        # always get fully decoded host strings.
+        probe = getattr(child, "set_device_consumer", None)
+        if probe is not None:
+            probe()
 
     def describe(self):
         return "HostToDevice"

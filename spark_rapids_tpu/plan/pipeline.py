@@ -66,11 +66,20 @@ def concat_static(batches: List[ColumnBatch], schema: T.Schema
     if len(batches) == 1:
         return batches[0]
     cap = round_up_capacity(sum(b.capacity for b in batches))
+
+    def _col_elem_cap(c):
+        # Dictionary-encoded inputs materialize inside concat_kway's
+        # row-layout guard: size the output for the decoded bytes, not
+        # the dictionary's.
+        if c.codes is not None:
+            return max(int(c.mat_byte_cap), 16)
+        return int(c.data.shape[0])
+
     byte_caps = []
     for i, f in enumerate(schema.fields):
         if f.dtype.is_string or f.dtype.is_array:
             byte_caps.append(BUCKETS.elems(
-                sum(int(b.columns[i].data.shape[0]) for b in batches)))
+                sum(_col_elem_cap(b.columns[i]) for b in batches)))
     return concat_kway(batches, cap, out_byte_caps=byte_caps or None)
 
 
@@ -141,6 +150,8 @@ def _batch_padded_bytes(b: ColumnBatch) -> int:
         total += c.validity.size * c.validity.dtype.itemsize
         if c.offsets is not None:
             total += c.offsets.size * c.offsets.dtype.itemsize
+        if c.codes is not None:
+            total += c.codes.size * c.codes.dtype.itemsize
     return total
 
 

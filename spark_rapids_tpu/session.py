@@ -301,6 +301,18 @@ class TpuSparkSession:
         frame.last_metrics["shuffleWallNs"] = sum(
             ms["shuffleWallNs"].value for ms in ctx.metrics.values()
             if "shuffleWallNs" in ms)
+        # scan/ingest economics (io.scan_v2), summed over every scan op:
+        # decode wall across pool workers, the part of it hidden behind
+        # the consumer's H2D/compute, decoded volume, dictionary-encoded
+        # column instances staged, and late-mat chunks skipped entirely
+        def _scan_sum(key):
+            return sum(ms[key].value for ms in ctx.metrics.values()
+                       if key in ms)
+        frame.last_metrics["scanDecodeWallNs"] = _scan_sum("scanDecodeWallNs")
+        frame.last_metrics["scanH2dOverlapNs"] = _scan_sum("scanH2dOverlapNs")
+        frame.last_metrics["scanBytesDecoded"] = _scan_sum("scanBytesDecoded")
+        frame.last_metrics["scanDictColumns"] = _scan_sum("scanDictColumns")
+        frame.last_metrics["scanChunksSkipped"] = _scan_sum("scanChunksSkipped")
         # adaptive-execution economics (plan/adaptive), summed over every
         # op that replanned: partitions merged away by post-shuffle
         # coalescing, joins switched to the broadcast shape at runtime,
@@ -561,7 +573,8 @@ def _infer_dtype(values) -> T.DataType:
 
 
 def _assert_on_tpu(op, allow=("HostToDeviceExec", "CpuInMemoryScanExec",
-                              "CpuFileScanExec", "DeviceToHostExec",
+                              "CpuFileScanExec", "FileScanV2Exec",
+                              "DeviceToHostExec",
                               "CpuShuffleExchangeExec")):
     """spark.rapids.sql.test.enabled analogue
     (GpuTransitionOverrides.scala:277-322)."""

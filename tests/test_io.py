@@ -87,7 +87,7 @@ def test_write_modes(tmp_path):
 
 def _scan_metrics(sess):
     for op, ms in sess.last_metrics.items():
-        if "CpuFileScanExec" in op and "rowGroupsTotal" in ms:
+        if "FileScan" in op and "rowGroupsTotal" in ms:
             return ms
     return {}
 

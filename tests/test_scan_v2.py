@@ -1,0 +1,330 @@
+"""Scan v2 (io.scan_v2) tests: bit parity with the v1 scan across formats
+and features (dictionary strings, nulls, partition-value columns, late
+materialization), read-ahead semantics, fault replay through the retry
+ladder, and clean resource accounting after a streamed scan."""
+
+import os
+import threading
+
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu import types as T
+
+from compare import tpu_session
+
+DATA = {
+    "i": (T.INT, [1, 2, None, 4, 5, 6, 7, None] * 25),
+    "l": (T.LONG, [10, None, 30, 40, 50, 60, 70, 80] * 25),
+    "d": (T.DOUBLE, [0.5, 1.5, None, 3.5, 4.5, 5.5, 6.5, 7.5] * 25),
+    # low-cardinality strings with nulls and empties: the dictionary case
+    "s": (T.STRING, ["aa", "bb", None, "bb", "", "cc", "aa", "cc"] * 25),
+}
+
+
+def _v1_session(**confs):
+    return tpu_session(**{"spark.rapids.sql.tpu.scan.v2.enabled": False,
+                          **confs})
+
+
+def _v2_session(**confs):
+    return tpu_session(**{"spark.rapids.sql.tpu.scan.v2.enabled": True,
+                          **confs})
+
+
+def _write_multi_row_group_parquet(tmp_path, name="pq", rows_per_group=40):
+    """Engine-written parquet rewritten into ONE file with small row
+    groups, so chunk-granular behavior is actually exercised."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    s = _v1_session()
+    out = str(tmp_path / name)
+    s.create_dataframe(DATA, num_partitions=2).write_parquet(out)
+    files = [f for f in os.listdir(out) if f.endswith(".parquet")]
+    big = pa.concat_tables(
+        [pq.read_table(os.path.join(out, f)) for f in files])
+    for f in files:
+        os.remove(os.path.join(out, f))
+    pq.write_table(big, os.path.join(out, "part-00000.parquet"),
+                   row_group_size=rows_per_group)
+    return out
+
+
+def _rows(session, build):
+    return sorted(build(session).collect(),
+                  key=lambda r: tuple((v is None, str(v)) for v in r))
+
+
+def _assert_v1_v2_parity(build, **confs):
+    want = _rows(_v1_session(**confs), build)
+    got = _rows(_v2_session(**confs), build)
+    assert got == want, (got[:5], want[:5])
+    return got
+
+
+# -- format parity -----------------------------------------------------------
+
+
+def test_parquet_parity_with_dict_strings_and_nulls(tmp_path):
+    out = _write_multi_row_group_parquet(tmp_path)
+    _assert_v1_v2_parity(lambda s: s.read.parquet(out))
+
+
+def test_parquet_parity_projection_and_filter(tmp_path):
+    out = _write_multi_row_group_parquet(tmp_path)
+
+    def q(s):
+        df = s.read.parquet(out)
+        return df.filter(df["i"] < 5).select("s", "l")
+    _assert_v1_v2_parity(q)
+
+
+def test_orc_parity(tmp_path):
+    s = _v1_session()
+    out = str(tmp_path / "orc")
+    s.create_dataframe(DATA, num_partitions=2).write_orc(out)
+    _assert_v1_v2_parity(lambda s2: s2.read.orc(out))
+
+
+def test_csv_parity(tmp_path):
+    s = _v1_session()
+    data = {k: v for k, v in DATA.items() if k != "s"}
+    out = str(tmp_path / "csv")
+    s.create_dataframe(data, num_partitions=2).write_csv(out)
+    _assert_v1_v2_parity(lambda s2: s2.read.csv(out))
+
+
+def test_partition_value_columns_parity(tmp_path):
+    """Hive-partitioned read: partition columns (including a string one,
+    which v2 dict-encodes) must round-trip identically."""
+    s = _v1_session()
+    out = str(tmp_path / "part_pq")
+    data = {
+        "k": (T.INT, [0, 0, 1, 1, 2, 2, 0, 1]),
+        "grp": (T.STRING, ["x", "x", "y", "y", "z", "z", "x", "y"]),
+        "v": (T.DOUBLE, [1.0, 2.0, 3.0, None, 5.0, 6.0, 7.0, 8.0]),
+    }
+    s.create_dataframe(data).write_parquet(out, partition_by=["grp"])
+
+    def q(s2):
+        df = s2.read.parquet(out)
+        return df.group_by("grp").agg(F.count("k").alias("c"),
+                                      F.sum("v").alias("sv"))
+    _assert_v1_v2_parity(q)
+
+
+# -- dict-encoded device paths -----------------------------------------------
+
+
+def test_string_filter_eq_parity(tmp_path):
+    out = _write_multi_row_group_parquet(tmp_path)
+
+    def q(s):
+        df = s.read.parquet(out)
+        return df.filter(df["s"] == "bb").select("i", "s")
+    rows = _assert_v1_v2_parity(q)
+    assert len(rows) == 50
+
+
+def test_string_groupby_keys_parity(tmp_path):
+    out = _write_multi_row_group_parquet(tmp_path)
+
+    def q(s):
+        df = s.read.parquet(out)
+        return df.group_by("s").agg(F.count("i").alias("c"),
+                                    F.sum("l").alias("sl"))
+    _assert_v1_v2_parity(q)
+
+
+def test_scan_dict_metrics_recorded(tmp_path):
+    out = _write_multi_row_group_parquet(tmp_path)
+    s = _v2_session()
+    df = s.read.parquet(out)
+    df.group_by("s").agg(F.count("i").alias("c")).collect()
+    m = s.last_metrics
+    assert m.get("scanBytesDecoded", 0) > 0, m
+    assert m.get("scanDecodeWallNs", 0) > 0, m
+    assert m.get("scanDictColumns", 0) > 0, m
+
+
+def test_dict_disabled_still_parity(tmp_path):
+    out = _write_multi_row_group_parquet(tmp_path)
+
+    def q(s):
+        df = s.read.parquet(out)
+        return df.group_by("s").agg(F.count("i").alias("c"))
+    _assert_v1_v2_parity(
+        q, **{"spark.rapids.sql.tpu.scan.dictEncoding.enabled": False})
+
+
+# -- late materialization ----------------------------------------------------
+
+
+def _needle_parquet(tmp_path):
+    """Unsorted tag column whose per-chunk min/max brackets the needle, so
+    row-group statistics cannot skip — only the exact late-mat probe can."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    rng = np.random.RandomState(3)
+    n = 4_000
+    tag = (rng.randint(-500, 500, n) * 2).astype(np.int64)
+    tag[2 * 500 + 11] = 501  # odd needle in chunk 2 of 8
+    out = str(tmp_path / "needle_pq")
+    os.makedirs(out)
+    pq.write_table(pa.table({
+        "tag": pa.array(tag),
+        "v": pa.array(rng.rand(n).round(4)),
+        "s": pa.array(np.array(["s%d" % (i % 7) for i in range(n)],
+                               dtype=object)),
+    }), os.path.join(out, "part-00000.parquet"), row_group_size=500)
+    return out
+
+
+def test_late_mat_selective_predicate_skips_chunks(tmp_path):
+    out = _needle_parquet(tmp_path)
+
+    def q(s):
+        df = s.read.parquet(out)
+        return df.filter(df["tag"] == 501)
+    rows = _assert_v1_v2_parity(q)
+    assert len(rows) == 1
+    s = _v2_session()
+    df = s.read.parquet(out)
+    assert len(df.filter(df["tag"] == 501).collect()) == 1
+    m = s.last_metrics
+    assert m.get("scanChunksSkipped", 0) == 7, m
+
+
+def test_late_mat_select_all_predicate_skips_nothing(tmp_path):
+    out = _needle_parquet(tmp_path)
+
+    def q(s):
+        df = s.read.parquet(out)
+        return df.filter(df["tag"] > -10_000)
+    rows = _assert_v1_v2_parity(q)
+    assert len(rows) == 4_000
+    s = _v2_session()
+    df = s.read.parquet(out)
+    assert len(df.filter(df["tag"] > -10_000).collect()) == 4_000
+    assert s.last_metrics.get("scanChunksSkipped", 0) == 0
+
+
+def test_late_mat_disabled_parity(tmp_path):
+    out = _needle_parquet(tmp_path)
+
+    def q(s):
+        df = s.read.parquet(out)
+        return df.filter(df["tag"] == 501)
+    _assert_v1_v2_parity(
+        q, **{"spark.rapids.sql.tpu.scan.lateMaterialization.enabled":
+              False})
+    s = _v2_session(**{
+        "spark.rapids.sql.tpu.scan.lateMaterialization.enabled": False})
+    df = s.read.parquet(out)
+    assert len(df.filter(df["tag"] == 501).collect()) == 1
+    assert s.last_metrics.get("scanChunksSkipped", 0) == 0
+
+
+def test_orc_non_projected_predicate_column_skips(tmp_path):
+    """Satellite regression: an ORC predicate on a column NOT in the
+    projection must still drive stripe skipping."""
+    s = _v1_session()
+    n = 2_000
+    data = {
+        "k": (T.LONG, list(range(n))),
+        "v": (T.DOUBLE, [float(i) * 0.5 for i in range(n)]),
+    }
+    out = str(tmp_path / "orc_sorted")
+    s.create_dataframe(data, num_partitions=1).write_orc(out)
+
+    def q(s2):
+        d = s2.read.orc(out)
+        return d.filter(d["k"] < 10).select("v")
+    rows = _assert_v1_v2_parity(q)
+    assert len(rows) == 10
+
+
+# -- read-ahead semantics ----------------------------------------------------
+
+
+@pytest.mark.parametrize("depth", [0, 1, 3, 16])
+def test_readahead_depth_values_all_equal(tmp_path, depth):
+    """Any depth (0 clamps to 1) yields the same deterministic rows in the
+    same submission order."""
+    out = _write_multi_row_group_parquet(tmp_path)
+    want = _rows(_v2_session(), lambda s: s.read.parquet(out))
+    s = _v2_session(**{"spark.rapids.sql.tpu.scan.readAhead.depth": depth})
+    got = _rows(s, lambda s2: s2.read.parquet(out))
+    assert got == want
+
+
+def test_readahead_window_is_bounded(tmp_path, monkeypatch):
+    """No more than `depth` decode futures may be in flight at once."""
+    import spark_rapids_tpu.io.scan_v2 as sv2
+    out = _write_multi_row_group_parquet(tmp_path, rows_per_group=20)
+    live = {"now": 0, "max": 0}
+    lock = threading.Lock()
+    orig = sv2.FileScanV2Exec._decode_parquet_chunk
+
+    def counting(self, *a, **kw):
+        with lock:
+            live["now"] += 1
+            live["max"] = max(live["max"], live["now"])
+        try:
+            return orig(self, *a, **kw)
+        finally:
+            with lock:
+                live["now"] -= 1
+    monkeypatch.setattr(sv2.FileScanV2Exec, "_decode_parquet_chunk",
+                        counting)
+    s = _v2_session(**{"spark.rapids.sql.tpu.scan.readAhead.depth": 2})
+    assert len(s.read.parquet(out).collect()) == 200
+    assert 1 <= live["max"] <= 2, live
+
+
+# -- faults + resource accounting --------------------------------------------
+
+
+def test_scan_oom_fault_replays_through_retry_ladder(tmp_path):
+    out = _write_multi_row_group_parquet(tmp_path)
+
+    def q(s):
+        df = s.read.parquet(out)
+        return df.group_by("s").agg(F.count("i").alias("c"))
+    want = _rows(_v2_session(), q)
+    s = _v2_session(**{"spark.rapids.sql.tpu.faults.spec": "scan:oom@2"})
+    got = _rows(s, q)
+    assert got == want
+    m = s.last_metrics
+    assert m["faultsInjected"] >= 1, m
+    assert m["retryCount"] >= 1, m
+
+
+def test_streamed_scan_leaves_clean_accounting(tmp_path):
+    out = _write_multi_row_group_parquet(tmp_path)
+    s = _v2_session()
+    df = s.read.parquet(out)
+    rows = df.group_by("s").agg(F.sum("l").alias("sl")).collect()
+    assert rows
+    assert s.runtime.semaphore.held_depth() == 0
+    cat = s.runtime.catalog
+    assert cat.device_bytes_in_use() == 0, cat.metrics
+
+
+def test_decode_pool_is_shared_and_bounded(tmp_path):
+    """Satellite regression: repeated scans must reuse ONE process pool
+    instead of leaking a fresh ThreadPoolExecutor per query."""
+    from spark_rapids_tpu.io.decode_pool import (
+        decode_pool_size, get_decode_pool,
+    )
+    out = _write_multi_row_group_parquet(tmp_path)
+    s = _v2_session()
+    for _ in range(3):
+        assert len(s.read.parquet(out).collect()) == 200
+    pool = get_decode_pool(1)  # does not shrink the existing pool
+    assert pool is get_decode_pool(1)
+    size = decode_pool_size()
+    n = sum(1 for t in threading.enumerate()
+            if t.name.startswith("rapids-decode"))
+    assert n <= size, (n, size)
