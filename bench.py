@@ -587,6 +587,81 @@ def time_spill():
     return async_gbps, sync_gbps, speedup, depth
 
 
+_MESH_CHILD = r"""
+import json, os, sys, time
+import numpy as np
+n = int(sys.argv[1]); spmd = sys.argv[2] == "on"; rows = int(sys.argv[3])
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.session import TpuSparkSession
+rng = np.random.RandomState(11)
+s = TpuSparkSession(RapidsConf({
+    "spark.rapids.sql.enabled": True,
+    "spark.rapids.shuffle.ici.enabled": True,
+    "spark.rapids.sql.variableFloatAgg.enabled": True,
+    "spark.rapids.sql.tpu.mesh.spmd.enabled": spmd,
+    "spark.sql.shuffle.partitions": max(2, n),
+}))
+df = s.create_dataframe({
+    "k": (T.INT, rng.randint(0, 64, rows).astype(np.int32).tolist()),
+    "v": (T.LONG, list(range(rows))),
+}, num_partitions=max(2, n))
+q = df.group_by("k").agg(F.sum("v").alias("sv"))
+q.collect()  # warmup (compile)
+t0 = time.monotonic()
+q.collect()
+wall = time.monotonic() - t0
+m = s.last_metrics
+print(json.dumps({
+    "rows_per_sec": round(rows / wall, 1) if wall > 0 else 0.0,
+    "backend": m.get("meshBackend", ""),
+    "fused": m.get("meshBoundariesFused", 0),
+}))
+"""
+
+
+def time_mesh():
+    """Multichip mesh-SPMD lane: the same two-stage shuffle query
+    (partial agg -> hash exchange -> merge agg) timed in subprocess
+    children pinned to 1/2/4/8 CPU virtual devices
+    (``--xla_force_host_platform_device_count``), SPMD fusion on — the
+    scaling curve — plus one SPMD-off child at the widest mesh for the
+    fused-vs-host-driven ratio.  Children force JAX_PLATFORMS=cpu so the
+    curve is honest about its backend: ``mesh_backend`` records what the
+    shuffle mesh actually ran on, and the ratio is informational on CPU
+    (host collectives emulate ICI; it is NOT gated)."""
+    rows = min(ROWS, 1 << 14)
+
+    def child(n, spmd):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n}"
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", _MESH_CHILD, str(n),
+                 "on" if spmd else "off", str(rows)],
+                capture_output=True, text=True, timeout=300, env=env)
+            line = out.stdout.strip().splitlines()[-1]
+            return json.loads(line)
+        except (subprocess.TimeoutExpired, IndexError,
+                json.JSONDecodeError):
+            return {"rows_per_sec": 0.0, "backend": "", "fused": 0}
+
+    curve = {}
+    backend = ""
+    for n in (1, 2, 4, 8):
+        r = child(n, True)
+        curve[str(n)] = r["rows_per_sec"]
+        if r["backend"]:
+            backend = r["backend"]
+    off = child(8, False)
+    on_rps = curve.get("8", 0.0)
+    ratio = round(on_rps / off["rows_per_sec"], 3) \
+        if off["rows_per_sec"] else 0.0
+    return curve, ratio, backend
+
+
 def main():
     try:
         platform = wait_for_backend()
@@ -633,6 +708,7 @@ def main():
     spill_gbps, spill_sync_gbps, spill_speedup, spill_depth = time_spill()
     aqe_rps, aqe_speedup, aqe_parity, aqe_counters = time_adaptive()
     serve = time_serve()
+    mesh_curve, mesh_ratio, mesh_backend = time_mesh()
 
     data_bytes = ROWS * _bytes_per_row(data)
     device_s = tpu_econ["device_ms"] / 1e3
@@ -712,6 +788,14 @@ def main():
         "serve_second_session_compiles":
             serve["serve_second_session_compiles"],
         "serve_tenants": serve["serve_tenants"],
+        # mesh-SPMD lane (parallel.mesh_spmd): rows/s scaling curve over
+        # 1/2/4/8 virtual devices with whole-stage fusion on, the
+        # fused-vs-host-driven throughput ratio at the widest mesh
+        # (informational — NOT gated on CPU, where host collectives
+        # emulate ICI), and the backend the mesh actually ran on
+        "mesh_rows_per_sec_by_devices": mesh_curve,
+        "mesh_spmd_vs_hostdriven": mesh_ratio,
+        "mesh_backend": mesh_backend,
         "platform": platform,
         "scan_rows_per_sec": round(SCAN_ROWS / scan_tpu, 1),
         "scan_vs_baseline": round(scan_cpu / scan_tpu, 3),

@@ -1,7 +1,7 @@
 """Runtime-free plan-invariant verifier.
 
 Checks structural invariants of an already-built physical plan — no
-dispatch, no device work, no re-execution.  Four families:
+dispatch, no device work, no re-execution.  Five families:
 
 * **Schema consistency** — every operator's output schema is well formed
   (unique names, concrete dtypes) and the planner-inserted transitions
@@ -15,6 +15,10 @@ dispatch, no device work, no re-execution.  Four families:
   broadcast builds are re-referenced across partitions/queries: donating
   one hands live HBM to XLA and the next read returns garbage (or a
   deleted-buffer error on backends that check).
+* **Mesh sharding** — fused mesh-SPMD stages declare a PartitionSpec for
+  every program input/output (replicated or leading with the ``data``
+  axis), flip sharding only at recorded reshard (exchange) nodes, and
+  never donate under sharding (``check_mesh_sharding``).
 * **Semaphore balance** — after a query completes, the task-wide
   re-entrant hold depth must be back to zero; a leaked permit silently
   halves device admission for every later query in the process.
@@ -152,6 +156,63 @@ def check_donation_provenance(root) -> List[str]:
     return problems
 
 
+def check_mesh_sharding(root) -> List[str]:
+    """Sharding invariants of fused mesh-SPMD stages
+    (``op._mesh_partition_specs``, written by parallel.mesh_spmd after
+    each fused dispatch):
+
+    * every program input/output leaf carries a DECLARED PartitionSpec,
+      and each is either fully replicated (no named axis — broadcast
+      build sides) or leads with the mesh's ``data`` axis (row-sharded);
+    * sharding boundaries flip only at explicit reshard nodes: the stage
+      records the fused exchanges it resharded through, each of which
+      must be a shuffle exchange inside the stage root's subtree;
+    * donation masks are all-False — a donated leaf of a mesh global
+      would hand ONE shard's buffer to XLA while the other shards (and a
+      device-lost replay) still reference the global."""
+    problems = []
+    for op in _walk(root):
+        specs = getattr(op, "_mesh_partition_specs", None)
+        if not isinstance(specs, dict):
+            continue
+        for role in ("in_specs", "out_specs"):
+            for i, spec in enumerate(specs.get(role, ())):
+                axes = tuple(spec) if spec is not None else None
+                if axes is None:
+                    problems.append(
+                        f"{_describe(op)}: mesh {role}[{i}] has no "
+                        "declared PartitionSpec")
+                elif not all(a is None for a in axes) and \
+                        (not axes or axes[0] != "data"):
+                    problems.append(
+                        f"{_describe(op)}: mesh {role}[{i}] = {spec} is "
+                        "neither replicated nor leading with the 'data' "
+                        "axis")
+        reshards = list(specs.get("reshards", ()))
+        if not reshards:
+            problems.append(
+                f"{_describe(op)}: fused mesh stage records no reshard "
+                "(exchange) boundary")
+        subtree_ids = {getattr(o, "op_id", None): o for o in _walk(op)}
+        for ex_id in reshards:
+            ex = subtree_ids.get(ex_id)
+            if ex is None:
+                problems.append(
+                    f"{_describe(op)}: mesh reshard {ex_id} is not in the "
+                    "stage root's subtree")
+                continue
+            if "ShuffleExchange" not in type(ex).__name__:
+                problems.append(
+                    f"{_describe(op)}: mesh reshard {ex_id} is a "
+                    f"{type(ex).__name__}, not a shuffle exchange — "
+                    "sharding may only flip at explicit reshard nodes")
+        if any(specs.get("dmask", ())):
+            problems.append(
+                f"{_describe(op)}: donation under mesh sharding "
+                f"(dmask={specs.get('dmask')})")
+    return problems
+
+
 def check_catalog_accounting(runtime) -> List[str]:
     """The spill catalog's incremental per-tier byte counters must equal a
     full handle scan (mem/catalog.py ``verify_accounting``): every tier
@@ -226,6 +287,7 @@ def verify_plan(root, runtime=None, ctx=None) -> None:
     problems += check_schemas(root)
     problems += check_boundaries(root)
     problems += check_donation_provenance(root)
+    problems += check_mesh_sharding(root)
     if ctx is not None:
         problems += check_adaptive_events(root, ctx)
     if runtime is not None:

@@ -246,6 +246,23 @@ ENABLE_ICI_SHUFFLE = conf_bool(
     "over the device mesh when >1 device is available.  Opt-in, like the "
     "reference's RapidsShuffleManager (docs/get-started.md); off means the "
     "single-host exchange path.")
+MESH_SPMD_ENABLED = conf_bool(
+    "spark.rapids.sql.tpu.mesh.spmd.enabled", False,
+    "Fuse contiguous plan segments on either side of a mesh shuffle into "
+    "ONE shard_map program: the exchange lowers to an in-program "
+    "lax.all_to_all over the ICI, broadcast-join build sides replicate "
+    "(PartitionSpec ()) and the boundary runs with zero host syncs "
+    "(host-driven mesh shuffle pays 1 sync + a restage per exchange).  "
+    "Requires shuffle.ici.enabled and >1 device; segments containing a "
+    "mesh-incompatible op (range/single partitioning, shuffled hash "
+    "join) stay on the host-driven path.  Bit-identical either way.")
+MESH_SPMD_AUTO_FALLBACK = conf_bool(
+    "spark.rapids.sql.tpu.mesh.spmd.autoFallback", True,
+    "With mesh.spmd.enabled, silently route mesh-incompatible exchanges "
+    "(range partitioning, single-partition collapses) through the "
+    "host-driven mesh shuffle instead of failing.  false raises on the "
+    "first incompatible exchange — a debugging aid to catch segments "
+    "dropping out of whole-stage SPMD fusion.")
 PINNED_POOL_SIZE = conf_bytes(
     "spark.rapids.memory.pinnedPool.size", 0,
     "Size of the pinned host staging pool used by the native runtime for "
@@ -474,7 +491,8 @@ FAULTS_SPEC = conf_str(
     "spark.rapids.sql.tpu.faults.spec", "",
     "Deterministic fault injection spec, e.g. "
     "\"dispatch:oom@3;d2h:device_lost@1;spill:slow=200ms@2\": at each "
-    "named site (dispatch, h2d, d2h, spill, unspill, exchange) the Nth "
+    "named site (dispatch, h2d, d2h, spill, unspill, exchange, scan, "
+    "mesh) the Nth "
     "call raises the named error class (or stalls, for slow=<dur>); @N+ "
     "fires from the Nth call onward.  Call counters reset per query.  "
     "Empty disables injection.")

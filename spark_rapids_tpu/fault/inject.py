@@ -9,6 +9,7 @@ Grammar (entries joined by ``;``)::
 
     entry    := site ":" kind ["=" duration] "@" n ["+"]
     site     := dispatch | h2d | d2h | spill | unspill | exchange | scan
+                | mesh
     kind     := oom | device_lost | slow
     duration := <float> ("ms" | "s")     (slow only; default ms)
     n        := 1-based call index at that site; "+" = that call AND
@@ -26,8 +27,11 @@ Sites are wired where real faults strike: ``instrumented_jit`` dispatch
 (batch.py), catalog spill and unspill (mem.catalog — ``spill`` fires on
 the async writer thread and the error surfaces at the consumer's
 ``get()``; ``unspill`` fires on the rehydration path), the shuffle
-exchange split (parallel.exchange) and the v2 scan's per-chunk decode
-submission (io.scan_v2).  The disarmed fast path is one module-global
+exchange split (parallel.exchange), the v2 scan's per-chunk decode
+submission (io.scan_v2) and the fused mesh-SPMD stage dispatch
+(parallel.mesh_spmd — ``mesh`` fires before the whole-stage program
+launches, so device-lost replays the full producer+exchange+consumer
+segment from lineage).  The disarmed fast path is one module-global
 ``is None`` test per call.
 """
 
@@ -41,7 +45,8 @@ from spark_rapids_tpu.fault import metrics as fault_metrics
 from spark_rapids_tpu.fault.errors import ErrorClass
 from spark_rapids_tpu.obs import events as obs_events
 
-SITES = ("dispatch", "h2d", "d2h", "spill", "unspill", "exchange", "scan")
+SITES = ("dispatch", "h2d", "d2h", "spill", "unspill", "exchange", "scan",
+         "mesh")
 KINDS = ("oom", "device_lost", "slow")
 
 

@@ -415,6 +415,55 @@ def gather_segments_kway_run(batches: Sequence[ColumnBatch], starts, counts,
 _GATHER_SEGMENTS_KWAY_JIT = None
 
 
+def stacked_row_compaction_indices(counts, n: int, cap: int, out_cap: int):
+    """Row map compacting n stacked segments into one flat batch.
+
+    The mesh exchange's receive side (and any [n, cap]-stacked layout)
+    holds one segment per source with ``counts[d]`` live rows; output row
+    r is segment ``bkt[r]`` row ``within[r]`` when ``live[r]``.  Returns
+    ``(bkt, within, live, total)``, all over the static ``out_cap`` —
+    searchsorted over the count cumsum, the sharded k-way sibling of
+    :func:`gather_segments_kway`'s scatter (there the inputs are separate
+    arrays; here one stacked axis, so a gather formulation wins).  Safe
+    inside ``jax.jit`` and inside ``shard_map``.
+    """
+    total = jnp.sum(counts).astype(jnp.int32)
+    cum = jnp.cumsum(counts)
+    starts = cum - counts
+    flat = jnp.arange(out_cap, dtype=jnp.int32)
+    bkt = jnp.clip(jnp.searchsorted(
+        cum, flat, side="right").astype(jnp.int32), 0, n - 1)
+    within = jnp.clip(flat - starts[bkt], 0, cap - 1)
+    live = flat < total
+    return bkt, within, live, total
+
+
+def gather_stacked_rows(stacked, bkt, within, live):
+    """Apply a :func:`stacked_row_compaction_indices` map to one
+    ``[n, cap]`` per-row payload (data or validity); dead output slots
+    zero-fill (False for bool)."""
+    return jnp.where(live, stacked[bkt, within],
+                     jnp.zeros((), stacked.dtype))
+
+
+def gather_stacked_elements(elems, ecounts, n: int, ecap: int,
+                            out_ecap: int):
+    """Compact n stacked varlen element streams (``elems[n, ecap]``,
+    ``ecounts[d]`` live elements each) into one flat ``[out_ecap]``
+    buffer — the element-axis counterpart of
+    :func:`stacked_row_compaction_indices`, so a received varlen column's
+    bytes land contiguous in segment order with zeros past the live
+    total."""
+    ecum = jnp.cumsum(ecounts)
+    eexcl = ecum - ecounts
+    p = jnp.arange(out_ecap, dtype=jnp.int32)
+    eb = jnp.clip(jnp.searchsorted(
+        ecum, p, side="right").astype(jnp.int32), 0, n - 1)
+    ew = jnp.clip(p - eexcl[eb], 0, ecap - 1)
+    return jnp.where(p < ecum[n - 1], elems[eb, ew],
+                     jnp.zeros((), elems.dtype))
+
+
 def concat_pair(a: ColumnBatch, b: ColumnBatch, out_capacity: int,
                 out_byte_caps: Optional[Sequence[int]] = None) -> ColumnBatch:
     """Concatenate two batches (same schema) into one of static capacity.

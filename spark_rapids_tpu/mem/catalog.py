@@ -91,6 +91,30 @@ def device_batch_bytes(batch: ColumnBatch) -> int:
     return total
 
 
+def device_batch_shard_bytes(batch: ColumnBatch) -> List[int]:
+    """Per-device resident bytes of a MESH-SHARDED batch (every leaf a
+    multi-device global array), ordered by device id.  Pure addressable-
+    shard metadata — shapes and dtypes, never a transfer or sync — so the
+    mesh-SPMD dispatcher can account a fused stage's HBM footprint per
+    shard (and obs can report bytes_per_device) without touching the
+    arrays.  Sums to :func:`device_batch_bytes` of the global batch for
+    the standard int32-offsets/codes layout."""
+    per: dict = {}
+
+    def _add(arr) -> None:
+        for s in arr.addressable_shards:
+            per[s.device] = per.get(s.device, 0) + int(s.data.nbytes)
+
+    for c in batch.columns:
+        _add(c.data)
+        _add(c.validity)
+        if c.offsets is not None:
+            _add(c.offsets)
+        if c.codes is not None:
+            _add(c.codes)
+    return [per[d] for d in sorted(per, key=lambda d: d.id)]
+
+
 class _SpillTask:
     """One in-flight tier move.  ``state`` transitions are guarded by the
     owning catalog's lock (queued -> running -> done, or queued ->
@@ -360,6 +384,21 @@ class BufferCatalog:
         # budget enforcement OUTSIDE the registry mutation: a synchronous
         # spill's D2H/compress must not stall concurrent register/get
         self.reserve(0, exclude=h.batch_id)
+        return h
+
+    def register_sharded(self, batch: ColumnBatch,
+                         priority: int = PRIORITY_ON_DECK) -> SpillableBatch:
+        """Register a MESH-SHARDED batch (every leaf a multi-device global
+        array) ONCE: one handle covers all shards, ``device_bytes`` is the
+        global total and ``handle.shard_bytes`` carries the per-device
+        split (:func:`device_batch_shard_bytes`).  Defaults to
+        PRIORITY_ON_DECK — the least spillable band — because a victim
+        pass spilling a sharded global would D2H-gather every shard and
+        rehydrate to ONE device; the mesh-SPMD dispatcher holds such
+        handles only across the unshard window and closes them before the
+        per-device outputs flow downstream."""
+        h = self.register(batch, priority)
+        h.shard_bytes = device_batch_shard_bytes(batch)
         return h
 
     def _unregister(self, h: SpillableBatch):

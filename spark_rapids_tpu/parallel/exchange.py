@@ -203,6 +203,8 @@ class TpuShuffleExchangeExec(TpuExec):
         return [gen(p) for p in self.children[0].partitions(ctx)]
 
     def pipeline_inline(self, ctx, build):
+        if self._mesh_active(ctx):
+            return self._mesh_spmd_inline(ctx, build)
         if not self._collapse_local(ctx):
             return None
         cf = build(self.children[0])
@@ -213,6 +215,66 @@ class TpuShuffleExchangeExec(TpuExec):
             for fn in fns:
                 bs = [fn(b) for b in bs]
             return bs
+
+        return f
+
+    def _mesh_spmd_inline(self, ctx, build):
+        """Whole-stage SPMD fusion (mesh.spmd.enabled): instead of
+        becoming a stage source that host-drives mesh_exchange_batches —
+        one sync + restage per exchange — the exchange lowers INTO the
+        surrounding stage program as an in-program all_to_all
+        (mesh_shuffle.exchange_batch_collective).  Producer segment,
+        shuffle and consumer segment then dispatch as ONE shard_map
+        program with zero host syncs at the boundary.
+
+        Returns None (exchange stays a host-driven stage source) when no
+        mesh build scope is active, or when the partitioning is not
+        mesh-compatible (partitioning.mesh_compatible: range needs an
+        eager host sample pre-pass; single would leave each shard a
+        private "partition 0", breaking global aggregates/limits) —
+        unless mesh.spmd.autoFallback is off, which turns that silent
+        fallback into an error for debugging fusion coverage."""
+        from spark_rapids_tpu.plan.pipeline import (
+            concat_static, mesh_build_scope,
+        )
+        scope = mesh_build_scope()
+        if scope is None:
+            return None
+        from spark_rapids_tpu.parallel.partitioning import mesh_compatible
+        if not mesh_compatible(self.partitioning):
+            from spark_rapids_tpu.config import MESH_SPMD_AUTO_FALLBACK
+            if not MESH_SPMD_AUTO_FALLBACK.get(ctx.conf):
+                raise RuntimeError(
+                    f"{self.describe()}: partitioning is not mesh-SPMD "
+                    "compatible and spark.rapids.sql.tpu.mesh.spmd."
+                    "autoFallback is disabled")
+            obs_events.emit_instant(
+                "exchange", "mesh_fallback", self.op_id,
+                partitioning=type(self.partitioning).__name__)
+            return None
+        from spark_rapids_tpu.parallel.mesh_shuffle import (
+            DATA_AXIS, exchange_batch_collective,
+        )
+        cf = build(self.children[0])
+        fns = list(self._input_fns)
+        n = ctx.mesh.shape[DATA_AXIS]
+        part = _mesh_partitioning(self.partitioning, n)
+        scope.exchanges.append(self)
+
+        def f(args):
+            bs = cf(args)
+            for fn in fns:
+                bs = [fn(b) for b in bs]
+            # one local concat per shard keeps pid assignment identical
+            # to the host-driven path's merged batch (concat compacts
+            # live rows at the front in input order, so row position —
+            # all round-robin sees — matches _concat_all's)
+            b = concat_static(bs, self.output_schema) if len(bs) != 1 \
+                else bs[0]
+            d = jax.lax.axis_index(DATA_AXIS)
+            pid = part.device_partition_ids(b, d)
+            return [exchange_batch_collective(
+                b, jnp.asarray(pid, jnp.int32), n)]
 
         return f
 
@@ -326,7 +388,8 @@ class TpuShuffleExchangeExec(TpuExec):
         ctx.metric(self.op_id, "shuffleWallNs").add(wall_ns)
         obs_events.emit_span(
             "exchange", "mesh", self.op_id, t0, t0 + wall_ns,
-            bytes=stats.get("payload_bytes", 0), devices=n)
+            bytes=stats.get("payload_bytes", 0), devices=n,
+            bytes_per_device=stats.get("bytes_per_device"))
         return [iter([b]) for b in out] if out else \
             [iter([]) for _ in range(n)]
 

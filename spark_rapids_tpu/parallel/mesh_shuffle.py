@@ -25,6 +25,7 @@ plan's shuffle rather than a standalone demo.
 
 from __future__ import annotations
 
+import logging
 from typing import List, Optional, Tuple
 
 import jax
@@ -34,6 +35,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.batch import ColumnBatch, DeviceColumn
+from spark_rapids_tpu.kernels.layout import (
+    gather_stacked_elements, gather_stacked_rows,
+    stacked_row_compaction_indices,
+)
 
 DATA_AXIS = "data"
 
@@ -54,6 +59,16 @@ def make_mesh(n_devices: Optional[int] = None) -> Mesh:
         except RuntimeError:
             cpu = []
         if len(cpu) >= n_devices:
+            if devs and devs[0].platform != cpu[0].platform:
+                # Through the explain sink (PR 10), not a bare print: a
+                # silent backend switch is how a bench run mislabels CPU
+                # virtual-device scaling as TPU scaling.
+                logging.getLogger("spark_rapids_tpu.explain").warning(
+                    "make_mesh: default platform %r has only %d device(s); "
+                    "falling back to %d CPU virtual devices — the mesh "
+                    "runs on cpu, NOT on %r",
+                    devs[0].platform, len(devs), n_devices,
+                    devs[0].platform)
             devs = cpu
         else:
             raise RuntimeError(
@@ -242,6 +257,156 @@ def _unshard(arrs):
     return [a[0] for a in arrs]
 
 
+def _exchange_shard(cols, nr, pid, sig, n: int, cap: int, ecaps,
+                    out_cap: int, out_ecaps):
+    """Per-shard body of the varlen re-bucketing all_to_all collective.
+
+    Traceable and collective-bearing: must run inside ``shard_map`` over
+    ``DATA_AXIS``.  Shared verbatim by the host-driven exchange
+    (:func:`_make_mesh_payload_fn`) and the fused whole-stage SPMD path
+    (:func:`exchange_batch_collective` via parallel.mesh_spmd), so the two
+    routes are bit-identical by construction.
+
+    ``cols`` is the flat single-device payload list in schema order
+    (varlen -> elements[ecap], offsets[cap+1], validity[cap]; fixed ->
+    data[cap], validity[cap]); ``ecaps``/``out_ecaps`` index by FIELD
+    ordinal (0 for fixed columns).  Returns (outs, total): the received
+    payload list in the same order (offsets rebuilt, zeros past the live
+    region) and the received live-row count.
+    """
+
+    def a2a(x):
+        return jax.lax.all_to_all(x, DATA_AXIS, 0, 0, tiled=False)
+
+    live = jnp.arange(cap, dtype=jnp.int32) < nr
+    pid = jnp.where(live, pid, n)  # padding rows -> dead bucket
+    order = jnp.argsort(pid, stable=True).astype(jnp.int32)
+    sorted_pid = pid[order]
+    counts = jnp.zeros(n + 1, jnp.int32).at[sorted_pid].add(
+        1, mode="drop")[:n]
+    starts = jnp.cumsum(counts) - counts
+    j_idx = jnp.arange(cap, dtype=jnp.int32)[None, :]
+    src = jnp.clip(starts[:, None] + j_idx, 0, cap - 1)
+    in_bucket = j_idx < counts[:, None]
+    rows = order[src]  # [n, cap] source row per (dest bucket, slot)
+
+    send = []          # bucketed payloads, one list entry per wire array
+    recv_plan = []     # (kind, ...) mirror for the receive side
+    slot = 0
+    for vi, is_varlen in enumerate(sig):
+        if is_varlen:
+            data, offs, valid = cols[slot], cols[slot + 1], cols[slot + 2]
+            ecap = ecaps[vi]
+            lens = jnp.where(live, offs[1:] - offs[:-1], 0) \
+                .astype(jnp.int32)
+            slens = lens[order]
+            scum = jnp.cumsum(slens).astype(jnp.int32)
+            sexcl = scum - slens
+            ecounts = jnp.zeros(n + 1, jnp.int32).at[sorted_pid].add(
+                slens, mode="drop")[:n]
+            estarts = jnp.cumsum(ecounts) - ecounts
+            k = jnp.arange(ecap, dtype=jnp.int32)[None, :]
+            pos = estarts[:, None] + k          # [n, ecap]
+            r = jnp.clip(jnp.searchsorted(
+                scum, pos, side="right").astype(jnp.int32), 0, cap - 1)
+            src_e = offs[order[r]] + (pos - sexcl[r])
+            elem = data[jnp.clip(src_e, 0, ecap - 1)]
+            elem = jnp.where(k < ecounts[:, None], elem,
+                             jnp.zeros((), data.dtype))
+            blens = jnp.where(in_bucket, lens[rows], 0)
+            bvalid = jnp.where(in_bucket, valid[rows], False)
+            send += [elem, blens, bvalid, ecounts]
+            recv_plan.append(("varlen", vi))
+            slot += 3
+        else:
+            data, valid = cols[slot], cols[slot + 1]
+            bdata = jnp.where(in_bucket, data[rows],
+                              jnp.zeros((), data.dtype))
+            bvalid = jnp.where(in_bucket, valid[rows], False)
+            send += [bdata, bvalid]
+            recv_plan.append(("fixed", vi))
+            slot += 2
+
+    wire = [a2a(x) for x in send] + [a2a(counts)]
+    r_counts = wire[-1]
+
+    # receive-side row compaction indices, shared by all columns
+    # (kernels/layout.py sharded k-way gather primitives)
+    bkt, within, live_o, total = stacked_row_compaction_indices(
+        r_counts, n, cap, out_cap)
+
+    outs = []
+    wi = 0
+    for kind, vi in recv_plan:
+        if kind == "varlen":
+            relem, rlens, rvalid, recounts = (
+                wire[wi], wire[wi + 1], wire[wi + 2], wire[wi + 3])
+            wi += 4
+            lens_o = jnp.where(live_o, rlens[bkt, within], 0)
+            offs_o = jnp.concatenate([
+                jnp.zeros(1, jnp.int32),
+                jnp.cumsum(lens_o).astype(jnp.int32)])
+            elem_o = gather_stacked_elements(
+                relem, recounts, n, ecaps[vi], out_ecaps[vi])
+            valid_o = gather_stacked_rows(rvalid, bkt, within, live_o)
+            outs += [elem_o, offs_o, valid_o]
+        else:
+            rdata, rvalid = wire[wi], wire[wi + 1]
+            wi += 2
+            data_o = gather_stacked_rows(rdata, bkt, within, live_o)
+            valid_o = gather_stacked_rows(rvalid, bkt, within, live_o)
+            outs += [data_o, valid_o]
+    return outs, total
+
+
+def exchange_batch_collective(batch: ColumnBatch, pid, n: int) -> ColumnBatch:
+    """In-program mesh exchange of one per-shard batch by destination pid.
+
+    The fused whole-stage SPMD entry (parallel.mesh_spmd): callable only
+    inside ``shard_map`` over ``DATA_AXIS``, where ``batch`` is the
+    shard-local producer output and ``pid`` int32[cap] names each row's
+    destination device.  ZERO host syncs: wire capacities come from the
+    batch's STATIC capacity buckets instead of the host-driven path's
+    live-size metadata round trip — the fused boundary trades bucket
+    padding on the wire for a sync-free dispatch.  Returns the shard's
+    received batch (capacity round_up(n*cap), rows in sender order), bit
+    identical to :func:`mesh_exchange_batches` output for the same rows.
+    """
+    from spark_rapids_tpu.batch import round_up_capacity
+    from spark_rapids_tpu.kernels.layout import ensure_row_layout
+    batch = ensure_row_layout(batch)
+    schema = batch.schema
+    cap = batch.capacity
+    sig = tuple(f.dtype.is_string or getattr(f.dtype, "is_array", False)
+                for f in schema.fields)
+    ecaps = tuple(int(batch.columns[ci].data.shape[0]) if sig[ci] else 0
+                  for ci in range(len(schema.fields)))
+    out_cap = round_up_capacity(n * cap)
+    out_ecaps = tuple(round_up_capacity(n * e, minimum=16) if e else 0
+                      for e in ecaps)
+    cols = []
+    for ci, c in enumerate(batch.columns):
+        if sig[ci]:
+            cols += [c.data, c.offsets.astype(jnp.int32), c.validity]
+        else:
+            cols += [c.data, c.validity]
+    outs, total = _exchange_shard(
+        cols, batch.num_rows, jnp.asarray(pid, jnp.int32), sig, n, cap,
+        ecaps, out_cap, out_ecaps)
+    new_cols = []
+    ai = 0
+    for ci, f in enumerate(schema.fields):
+        if sig[ci]:
+            elem, offs, valid = outs[ai], outs[ai + 1], outs[ai + 2]
+            ai += 3
+            new_cols.append(DeviceColumn(f.dtype, elem, valid, offs))
+        else:
+            data, valid = outs[ai], outs[ai + 1]
+            ai += 2
+            new_cols.append(DeviceColumn(f.dtype, data, valid, None))
+    return ColumnBatch(schema, new_cols, total, out_cap)
+
+
 def _make_mesh_payload_fn(mesh: Mesh, sig, cap: int, ecaps: tuple,
                           out_cap: int, out_ecaps: tuple):
     """The SPMD exchange program over one batch schema shape.
@@ -252,109 +417,14 @@ def _make_mesh_payload_fn(mesh: Mesh, sig, cap: int, ecaps: tuple,
     """
     n = mesh.shape[DATA_AXIS]
 
-    def a2a(x):
-        return jax.lax.all_to_all(x, DATA_AXIS, 0, 0, tiled=False)
-
     def spmd(payloads):
         pls = [p[0] for p in payloads[:-1]]
         nr = payloads[-1][0]
         pid = pls[-1]
         cols = pls[:-1]
-
-        live = jnp.arange(cap, dtype=jnp.int32) < nr
-        pid = jnp.where(live, pid, n)  # padding rows -> dead bucket
-        order = jnp.argsort(pid, stable=True).astype(jnp.int32)
-        sorted_pid = pid[order]
-        counts = jnp.zeros(n + 1, jnp.int32).at[sorted_pid].add(
-            1, mode="drop")[:n]
-        starts = jnp.cumsum(counts) - counts
-        j_idx = jnp.arange(cap, dtype=jnp.int32)[None, :]
-        src = jnp.clip(starts[:, None] + j_idx, 0, cap - 1)
-        in_bucket = j_idx < counts[:, None]
-        rows = order[src]  # [n, cap] source row per (dest bucket, slot)
-
-        send = []          # bucketed payloads, one list entry per wire array
-        recv_plan = []     # (kind, ...) mirror for the receive side
-        slot = 0
-        for vi, is_varlen in enumerate(sig):
-            if is_varlen:
-                data, offs, valid = cols[slot], cols[slot + 1], cols[slot + 2]
-                ecap = ecaps[vi]
-                lens = jnp.where(live, offs[1:] - offs[:-1], 0) \
-                    .astype(jnp.int32)
-                slens = lens[order]
-                scum = jnp.cumsum(slens).astype(jnp.int32)
-                sexcl = scum - slens
-                ecounts = jnp.zeros(n + 1, jnp.int32).at[sorted_pid].add(
-                    slens, mode="drop")[:n]
-                estarts = jnp.cumsum(ecounts) - ecounts
-                k = jnp.arange(ecap, dtype=jnp.int32)[None, :]
-                pos = estarts[:, None] + k          # [n, ecap]
-                r = jnp.clip(jnp.searchsorted(
-                    scum, pos, side="right").astype(jnp.int32), 0, cap - 1)
-                src_e = offs[order[r]] + (pos - sexcl[r])
-                elem = data[jnp.clip(src_e, 0, ecap - 1)]
-                elem = jnp.where(k < ecounts[:, None], elem,
-                                 jnp.zeros((), data.dtype))
-                blens = jnp.where(in_bucket, lens[rows], 0)
-                bvalid = jnp.where(in_bucket, valid[rows], False)
-                send += [elem, blens, bvalid, ecounts]
-                recv_plan.append(("varlen", vi))
-                slot += 3
-            else:
-                data, valid = cols[slot], cols[slot + 1]
-                bdata = jnp.where(in_bucket, data[rows],
-                                  jnp.zeros((), data.dtype))
-                bvalid = jnp.where(in_bucket, valid[rows], False)
-                send += [bdata, bvalid]
-                recv_plan.append(("fixed", vi))
-                slot += 2
-
-        wire = [a2a(x) for x in send] + [a2a(counts)]
-        r_counts = wire[-1]
-
-        # receive-side row compaction indices, shared by all columns
-        total = jnp.sum(r_counts).astype(jnp.int32)
-        cum = jnp.cumsum(r_counts)
-        starts2 = cum - r_counts
-        flat = jnp.arange(out_cap, dtype=jnp.int32)
-        bkt = jnp.clip(jnp.searchsorted(
-            cum, flat, side="right").astype(jnp.int32), 0, n - 1)
-        within = jnp.clip(flat - starts2[bkt], 0, cap - 1)
-        live_o = flat < total
-
-        outs = []
-        wi = 0
-        for kind, vi in recv_plan:
-            if kind == "varlen":
-                relem, rlens, rvalid, recounts = (
-                    wire[wi], wire[wi + 1], wire[wi + 2], wire[wi + 3])
-                wi += 4
-                lens_o = jnp.where(live_o, rlens[bkt, within], 0)
-                offs_o = jnp.concatenate([
-                    jnp.zeros(1, jnp.int32),
-                    jnp.cumsum(lens_o).astype(jnp.int32)])
-                ecap = ecaps[vi]
-                oecap = out_ecaps[vi]
-                ecum = jnp.cumsum(recounts)
-                eexcl = ecum - recounts
-                p = jnp.arange(oecap, dtype=jnp.int32)
-                eb = jnp.clip(jnp.searchsorted(
-                    ecum, p, side="right").astype(jnp.int32), 0, n - 1)
-                ew = jnp.clip(p - eexcl[eb], 0, ecap - 1)
-                elem_o = jnp.where(p < ecum[n - 1], relem[eb, ew],
-                                   jnp.zeros((), relem.dtype))
-                valid_o = jnp.where(live_o, rvalid[bkt, within], False)
-                outs += [elem_o[None], offs_o[None], valid_o[None]]
-            else:
-                rdata, rvalid = wire[wi], wire[wi + 1]
-                wi += 2
-                data_o = jnp.where(live_o, rdata[bkt, within],
-                                   jnp.zeros((), rdata.dtype))
-                valid_o = jnp.where(live_o, rvalid[bkt, within], False)
-                outs += [data_o[None], valid_o[None]]
-        outs.append(total[None])
-        return outs
+        outs, total = _exchange_shard(
+            cols, nr, pid, sig, n, cap, ecaps, out_cap, out_ecaps)
+        return [o[None] for o in outs] + [total[None]]
 
     try:
         from jax import shard_map  # jax >= 0.6 top-level export
@@ -449,11 +519,11 @@ def mesh_exchange_batches(mesh: Mesh, local_batches, pids_list,
             varlen_byte_scales
         frb = fixed_row_bytes(schema)
         vscales = varlen_byte_scales(schema)
-        payload = 0
-        for rows, totals in sizes:
-            payload += rows * frb + sum(
-                t * sc for t, sc in zip(totals, vscales))
-        stats["payload_bytes"] = payload
+        by_dev = {d: rows * frb + sum(
+            t * sc for t, sc in zip(totals, vscales))
+            for d, (rows, totals) in zip(present, sizes)}
+        stats["bytes_per_device"] = [by_dev.get(d, 0) for d in range(n)]
+        stats["payload_bytes"] = sum(by_dev.values())
         # wire arrays: per column, bucketed [n, cap] (or [n, ecap]) on each
         # of n devices -> n x the packed global size, + counts
         wire = 0

@@ -87,6 +87,23 @@ class RoundRobinPartitioning(Partitioning):
             % np.int32(self.num_partitions)
 
 
+def mesh_compatible(p: Partitioning) -> bool:
+    """Whether ``p``'s pid computation can lower INTO a mesh-SPMD
+    shard_map program (the per-operator partitioning requirement the
+    exchange threads into whole-stage lowering — see docs/mesh.md).
+
+    Hash and round-robin qualify: their device_partition_ids are pure
+    traced jnp over (batch, part_index), and ``lax.axis_index`` supplies
+    part_index in-program.  Range does NOT — its bounds come from an
+    eager host-side sample pre-pass (:meth:`RangePartitioning.prepare`),
+    a sync by construction.  Single does not either: fusing it would
+    leave each shard holding "partition 0" locally, so a downstream
+    global aggregate or limit would run once PER SHARD (n rows where the
+    contract is 1) — single-partition consumers depend on seeing ONE
+    merged partition, which only the host-driven path provides."""
+    return isinstance(p, (HashPartitioning, RoundRobinPartitioning))
+
+
 class RangePartitioning(Partitioning):
     """Sample-based range bounds (GpuRangePartitioner analogue).  Bounds are
     computed host-side from a sample by the exchange, then broadcast into the

@@ -90,6 +90,17 @@ class ExecContext:
             ops[name] = Metric(name)
         return ops[name]
 
+    def mesh_spmd_active(self) -> bool:
+        """True when whole-stage SPMD fusion may run for this query: a
+        multi-device mesh is installed AND mesh.spmd.enabled.  Both the
+        stage builder (plan/pipeline) and the fusable ops (shuffle
+        exchange, broadcast join) consult this single gate, so a plan
+        segment can never half-fuse."""
+        if self.mesh is None:
+            return False
+        from spark_rapids_tpu.config import MESH_SPMD_ENABLED
+        return MESH_SPMD_ENABLED.get(self.conf)
+
 
 def _release_admission(ctx: ExecContext, n: int = 1) -> None:
     """Release ``n`` H2D-paired admission permits and keep the query's

@@ -40,6 +40,7 @@ Every stage program dispatch is counted and device-timed
 
 from __future__ import annotations
 
+import threading
 from typing import Callable, List, Optional, Tuple
 
 import jax
@@ -107,6 +108,53 @@ def build_pipeline(op: PhysicalOp, ctx: ExecContext,
         f = lambda args, _i=idx: list(args[_i])  # noqa: E731
     memo[id(op)] = f
     return f
+
+
+class MeshBuildScope:
+    """Build-time channel between the stage builder and mesh-fusable ops,
+    active only while ``ExecContext.mesh_spmd_active()``.
+
+    ``TpuShuffleExchangeExec.pipeline_inline`` appends itself to
+    ``exchanges`` when it fuses as an in-program all_to_all instead of
+    becoming a host-driven stage source; ``TpuBroadcastHashJoinExec``
+    records in ``replicated`` the source indices its build side added, so
+    parallel.mesh_spmd feeds those sources as PartitionSpec-()
+    replicated globals.  ``sources`` aliases the stage's live source
+    list, letting ops observe indices as ``build_pipeline`` appends."""
+
+    def __init__(self, sources: List[PhysicalOp]):
+        self.sources = sources
+        self.exchanges: List[PhysicalOp] = []
+        self.replicated: set = set()
+
+
+_MESH_BUILD = threading.local()
+
+
+def mesh_build_scope() -> Optional[MeshBuildScope]:
+    """The innermost active mesh-SPMD build scope; None outside a stage
+    build or when SPMD fusion is off — ops treat None as 'do not
+    mesh-fuse', which routes exchanges to the host-driven mesh path."""
+    stack = getattr(_MESH_BUILD, "stack", None)
+    return stack[-1] if stack else None
+
+
+def _mesh_scoped_build(root: PhysicalOp, ctx: ExecContext,
+                       sources: List[PhysicalOp]):
+    """Run :func:`build_pipeline` under a :class:`MeshBuildScope` when
+    SPMD fusion is active for ``ctx``; (fn, scope-or-None)."""
+    if not ctx.mesh_spmd_active():
+        return build_pipeline(root, ctx, sources, {}, root), None
+    scope = MeshBuildScope(sources)
+    stack = getattr(_MESH_BUILD, "stack", None)
+    if stack is None:
+        stack = _MESH_BUILD.stack = []
+    stack.append(scope)
+    try:
+        fn = build_pipeline(root, ctx, sources, {}, root)
+    finally:
+        stack.pop()
+    return fn, scope
 
 
 def _shrink_threshold(ctx: ExecContext) -> int:
@@ -249,6 +297,23 @@ def _shrink_outputs(outs: List[ColumnBatch], ctx: ExecContext
     return _apply_shrink(outs, spec, ctx)
 
 
+def _shrink_outputs_sharded(outs: List[ColumnBatch], ctx: ExecContext
+                            ) -> List[ColumnBatch]:
+    """Mesh-stage variant of :func:`_shrink_outputs`: the unsharded
+    outputs are committed one per mesh device, so the re-bucketing gather
+    dispatches per batch (each on its own device — ONE jit over the whole
+    tuple would be an illegal cross-device program).  Still exactly one
+    sizes round trip for the lot.  No donation: per-batch signatures
+    would fragment the donate cache, and mesh outputs are short-lived."""
+    spec = _shrink_spec(outs, ctx)
+    if spec is None:
+        return outs
+    ctx.metric("pipeline", "shrinks").add(1)
+    return [
+        _shrink_jit((b,), (cap,), (bcaps,))[0]
+        for b, (cap, bcaps) in zip(outs, spec)]
+
+
 def _materialize_sources(sources: List[PhysicalOp], ctx: ExecContext,
                          fuse: bool) -> List[list]:
     """Materialize every stage source -> [[batches, shrink_spec,
@@ -326,7 +391,14 @@ def _stage_build(root: PhysicalOp, ctx: ExecContext, variant: str):
         root._stage_builds = cache
     if variant not in cache:
         sources: List[PhysicalOp] = []
-        fn = build_pipeline(root, ctx, sources, {}, root)
+        fn, scope = _mesh_scoped_build(root, ctx, sources)
+        if scope is not None and scope.exchanges:
+            minfo = getattr(root, "_mesh_stage_info", None)
+            if not isinstance(minfo, dict):
+                minfo = {}
+                root._mesh_stage_info = minfo
+            minfo[variant] = (list(scope.exchanges),
+                              frozenset(scope.replicated))
         cache[variant] = (sources, fn)
     return cache[variant]
 
@@ -427,6 +499,27 @@ def _run_stage(root: PhysicalOp, ctx: ExecContext,
     variant = variant_fn(ctx) if variant_fn is not None else "default"
     fuse = _fuse_tail_enabled(ctx)
     sources, _fn = _stage_build(root, ctx, variant)
+    minfo = getattr(root, "_mesh_stage_info", None)
+    if isinstance(minfo, dict) and variant in minfo:
+        # the build fused at least one exchange as an in-program
+        # all_to_all: this stage MUST run as a mesh-sharded shard_map
+        # program — the single-device path below would trace
+        # lax.axis_index with no mesh axis bound
+        from spark_rapids_tpu.parallel.mesh_spmd import run_mesh_stage
+
+        def dispatch_mesh(v: str) -> List[ColumnBatch]:
+            return run_mesh_stage(root, ctx, v, shrink=shrink)
+
+        outs = dispatch_mesh(variant)
+        post = getattr(root, "postprocess_stage_outputs", None)
+        if post is not None:
+            def rerun_mesh():
+                v2 = variant_fn(ctx) if variant_fn is not None \
+                    else "default"
+                return dispatch_mesh(v2)
+
+            outs = post(ctx, outs, rerun_mesh)
+        return outs
     mats = _materialize_sources(sources, ctx, fuse)
     args = tuple(tuple(bs) for bs, _, _ in mats)
     spec = tuple(sp for _, sp, _ in mats) if fuse else None
@@ -483,7 +576,11 @@ def pipeline_collect(root: PhysicalOp, ctx: ExecContext
     probe = getattr(root, "_pipeline_viable", None)
     if probe is None:
         sources: List[PhysicalOp] = []
-        build_pipeline(root, ctx, sources, {}, root)
+        # probe under the mesh scope too: with SPMD fusion on, a plan
+        # whose root consumes only a fused exchange (repartition/distinct
+        # collected straight off the shuffle) is viable even though the
+        # scope-less build would leave root as its own sole source
+        _mesh_scoped_build(root, ctx, sources)
         probe = not (len(sources) == 1 and sources[0] is root)
         root._pipeline_viable = probe
     if not probe:

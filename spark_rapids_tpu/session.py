@@ -301,6 +301,22 @@ class TpuSparkSession:
         frame.last_metrics["shuffleWallNs"] = sum(
             ms["shuffleWallNs"].value for ms in ctx.metrics.values()
             if "shuffleWallNs" in ms)
+        # mesh-SPMD economics (parallel.mesh_spmd): whole-stage programs
+        # dispatched, exchange boundaries fused into them (each one is a
+        # shuffle that ran as an in-program all_to_all with ZERO host
+        # syncs), and which backend the shuffle mesh actually ran on —
+        # bench consumers must not mislabel a CPU-virtual-device curve
+        # as TPU ICI scaling
+        frame.last_metrics["meshProgramDispatches"] = sum(
+            ms["meshProgramDispatches"].value for ms in ctx.metrics.values()
+            if "meshProgramDispatches" in ms)
+        frame.last_metrics["meshBoundariesFused"] = sum(
+            ms["meshBoundariesFused"].value for ms in ctx.metrics.values()
+            if "meshBoundariesFused" in ms)
+        _mesh = self._shuffle_mesh()
+        frame.last_metrics["meshBackend"] = (
+            str(next(iter(_mesh.devices.flat)).platform)
+            if _mesh is not None else "")
         # scan/ingest economics (io.scan_v2), summed over every scan op:
         # decode wall across pool workers, the part of it hidden behind
         # the consumer's H2D/compute, decoded volume, dictionary-encoded
