@@ -522,6 +522,51 @@ def time_adaptive():
             speedup, on_rows == off_rows, counters)
 
 
+def time_history():
+    """Query-intelligence lane (history/): warm-vs-cold wall on the same
+    aggregation with a fresh statistics store.  Both timed runs are
+    compile-free (the plan's programs are warmed first); the cold run
+    re-executes the whole subtree, the warm run serves it from the
+    cross-query fragment cache — the ratio is pure fragment-reuse
+    speedup.  Returns (warm speedup, fragmentCacheHits of the warm
+    run)."""
+    import shutil
+    import tempfile
+
+    from spark_rapids_tpu import functions as F
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.history.fragcache import fragment_cache
+    from spark_rapids_tpu.session import TpuSparkSession
+    rows = min(ROWS, 1 << 18)
+    hist_dir = tempfile.mkdtemp(prefix="rapids_tpu_bench_hist_")
+    try:
+        s = TpuSparkSession(RapidsConf({
+            "spark.rapids.sql.enabled": True,
+            # float sums stay on-device (tpcds suite convention) — the
+            # CPU-fallback plan would bypass the fragment cache entirely
+            "spark.rapids.sql.variableFloatAgg.enabled": True,
+            "spark.rapids.sql.tpu.history.dir": hist_dir,
+        }))
+        df = s.create_dataframe(make_data(rows), num_partitions=4)
+        q = df.group_by("ss_promo_sk").agg(
+            F.sum("ss_sales_price").alias("sum_price"),
+            F.count("ss_quantity").alias("cnt"))
+        q.collect()  # warmup: compile + first store record
+        fragment_cache().clear()
+        t0 = time.monotonic()
+        cold = q.collect()  # full re-execution (compile-free)
+        cold_wall = time.monotonic() - t0
+        t0 = time.monotonic()
+        warm = q.collect()  # fragment-cache hit
+        warm_wall = time.monotonic() - t0
+        hits = s.last_metrics.get("fragmentCacheHits", 0)
+        assert sorted(cold) == sorted(warm), "history warm/cold parity"
+        speedup = round(cold_wall / warm_wall, 3) if warm_wall else 0.0
+        return speedup, hits
+    finally:
+        shutil.rmtree(hist_dir, ignore_errors=True)
+
+
 def _async_partitions_default() -> bool:
     from spark_rapids_tpu.config import PIPELINE_ASYNC_PARTITIONS, RapidsConf
     return bool(PIPELINE_ASYNC_PARTITIONS.get(RapidsConf()))
@@ -708,6 +753,7 @@ def main():
     spill_gbps, spill_sync_gbps, spill_speedup, spill_depth = time_spill()
     aqe_rps, aqe_speedup, aqe_parity, aqe_counters = time_adaptive()
     serve = time_serve()
+    history_speedup, history_hits = time_history()
     mesh_curve, mesh_ratio, mesh_backend = time_mesh()
 
     data_bytes = ROWS * _bytes_per_row(data)
@@ -788,6 +834,12 @@ def main():
         "serve_second_session_compiles":
             serve["serve_second_session_compiles"],
         "serve_tenants": serve["serve_tenants"],
+        # query-intelligence lane (history/): warm-vs-cold wall ratio on
+        # the same aggregation (both runs compile-free — the warm run
+        # serves the whole subtree from the cross-query fragment cache
+        # with zero dispatches) and the warm run's hit count
+        "history_warm_speedup": history_speedup,
+        "fragment_cache_hits": history_hits,
         # mesh-SPMD lane (parallel.mesh_spmd): rows/s scaling curve over
         # 1/2/4/8 virtual devices with whole-stage fusion on, the
         # fused-vs-host-driven throughput ratio at the widest mesh

@@ -76,7 +76,8 @@ for key in ("value", "donated_bytes", "h2d_gb_per_sec", "d2h_gb_per_sec",
             "scan_gb_per_sec", "scan_decode_gb_per_sec",
             "scan_h2d_overlap_pct", "scan_chunks_skipped",
             "scan_v2_vs_v1", "mesh_rows_per_sec_by_devices",
-            "mesh_spmd_vs_hostdriven", "mesh_backend"):
+            "mesh_spmd_vs_hostdriven", "mesh_backend",
+            "history_warm_speedup", "fragment_cache_hits"):
     assert key in j, f"bench JSON missing {key}: {sorted(j)}"
 assert j["value"] > 0, j
 assert j["scan_gb_per_sec"] > 0, j
@@ -87,6 +88,8 @@ assert j["serve_parity"] is True, j
 assert j["serve_batched_queries"] > 0, j
 assert j["serve_second_session_compiles"] == 0, j
 assert isinstance(j["mesh_rows_per_sec_by_devices"], dict), j
+assert j["fragment_cache_hits"] > 0, j
+assert j["history_warm_speedup"] > 0, j
 # fused-vs-host-driven ratio is recorded, NOT gated: CPU virtual devices
 # emulate ICI through host collectives, so the ratio is informational
 print("mesh spmd vs host-driven (informational):",
@@ -171,6 +174,58 @@ print("obs smoke ok:", {
     "events": s.last_metrics["obsEventCount"],
     "dropped": s.last_metrics["obsEventsDropped"],
     "trace_events": len(tdoc["traceEvents"])})
+PY
+
+echo "== history smoke: same aggregation twice against a fresh history"
+echo "   dir — the repeat must serve from the fragment cache (hits > 0,"
+echo "   zero compiles, zero dispatches) with bit-identical rows, and the"
+echo "   statistics store must be inspectable with rapidshist"
+python - << 'PY'
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.history.fragcache import fragment_cache
+from spark_rapids_tpu.session import TpuSparkSession
+
+hist_dir = tempfile.mkdtemp(prefix="rapids_hist_smoke_")
+try:
+    fragment_cache().clear()
+    s = TpuSparkSession(RapidsConf({
+        "spark.rapids.sql.enabled": True,
+        "spark.rapids.sql.tpu.history.dir": hist_dir,
+    }))
+    df = s.create_dataframe(
+        {"k": [i % 7 for i in range(4096)], "v": list(range(4096))},
+        num_partitions=2)
+    q = df.group_by("k").sum("v")
+    want = sorted(q.collect())
+    m1 = dict(s.last_metrics)
+    got = sorted(q.collect())
+    m2 = dict(s.last_metrics)
+    assert got == want, f"warm run diverged:\n{got[:5]}\n{want[:5]}"
+    assert m2["fragmentCacheHits"] > 0, m2
+    assert m2["compileCount"] == 0, m2
+    assert m2["dispatchCount"] == 0, m2
+    assert os.path.exists(os.path.join(hist_dir, "stats.jsonl")), \
+        os.listdir(hist_dir)
+    out = subprocess.run(
+        [sys.executable, "tools/rapidshist.py", hist_dir],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, f"rapidshist failed:\n{out.stderr[-2000:]}"
+    assert "fingerprint" in out.stdout, out.stdout
+    print("history smoke ok:", {
+        "cold_compiles": m1["compileCount"],
+        "warm_hits": m2["fragmentCacheHits"],
+        "warm_compiles": m2["compileCount"],
+        "warm_dispatches": m2["dispatchCount"],
+        "store_queries": m1["statsStoreQueries"]})
+finally:
+    fragment_cache().clear()
+    shutil.rmtree(hist_dir, ignore_errors=True)
 PY
 
 echo "== fault-injection smoke: dispatch:oom@2 must spill-retry and still"

@@ -591,6 +591,56 @@ SERVE_PLAN_CACHE_MAX = conf_int(
     "(serve.excache) — entries pin their physical plans and compiled "
     "stage programs; past the bound the least-recently-hit plan is "
     "dropped (its executables fall out with it).")
+HISTORY_ENABLED = conf_bool(
+    "spark.rapids.sql.tpu.history.enabled", True,
+    "Master switch for the query-intelligence layer (history/): the "
+    "persistent plan-fingerprint statistics store, history-seeded "
+    "planning and the cross-query fragment cache.  Takes effect only "
+    "when spark.rapids.sql.tpu.history.dir is also set; false pins "
+    "byte-for-byte the history-free plans and behavior.")
+HISTORY_DIR = conf_str(
+    "spark.rapids.sql.tpu.history.dir", "",
+    "Directory of the persistent statistics store (history.store): each "
+    "query appends one JSONL record of runtime facts keyed by plan "
+    "fingerprint (per-exchange rows/bytes, skew, spill pressure, "
+    "compile wall), read back lazily to seed later plans.  Empty "
+    "disables the whole history subsystem.")
+HISTORY_SEED_ENABLED = conf_bool(
+    "spark.rapids.sql.tpu.history.seed.enabled", True,
+    "History-seeded planning (history.seeding): before first execution "
+    "consult the store to right-size shuffle partition counts, hint the "
+    "broadcast build side and pre-mark skewed partitions — AQE v1's "
+    "runtime decisions applied up front.  A stats-absent or stats-stale "
+    "store degrades to exactly the unseeded plan.")
+HISTORY_FRAGMENTS_ENABLED = conf_bool(
+    "spark.rapids.sql.tpu.history.fragments.enabled", True,
+    "Cross-query fragment cache (history.fragcache): materialized "
+    "root-subtree outputs are kept as catalog-registered spillable "
+    "batches keyed by (plan fingerprint, conf signature, input "
+    "identity); a repeat query re-executes zero dispatches for the "
+    "cached subtree.  Entries ride the device->host->disk spill tiers "
+    "and are never pinned.")
+HISTORY_MAX_AGE_SEC = conf_float(
+    "spark.rapids.sql.tpu.history.maxAgeSec", 604800.0,
+    "Staleness horizon for store records consulted by seeding: records "
+    "older than this many seconds (or written under a different "
+    "plan-relevant conf signature) are ignored, degrading to the "
+    "unseeded plan.  <=0 disables the age check.")
+HISTORY_STORE_MAX_RECORDS = conf_int(
+    "spark.rapids.sql.tpu.history.store.maxRecords", 1024,
+    "Per-store record bound honored by tools/rapidshist.py prune and "
+    "the in-process loader: when the JSONL holds more records, only the "
+    "newest per fingerprint (newest-first overall) are kept.")
+HISTORY_FRAGMENTS_MAX_ENTRIES = conf_int(
+    "spark.rapids.sql.tpu.history.fragments.maxEntries", 64,
+    "LRU entry bound on the process-wide fragment cache; past it the "
+    "least-recently-hit fragment's batches are closed and its catalog "
+    "bytes released.")
+HISTORY_FRAGMENTS_MAX_BYTES = conf_bytes(
+    "spark.rapids.sql.tpu.history.fragments.maxBytes", 256 << 20,
+    "Byte bound on fragment-cache residency (sum of cached batch "
+    "payloads across tiers); inserting past it evicts least-recently-"
+    "hit fragments first.  0 disables insertion.")
 
 
 def registry() -> List[ConfEntry]:

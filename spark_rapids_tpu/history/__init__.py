@@ -1,0 +1,138 @@
+"""Query intelligence (history/): cross-query learning and reuse.
+
+Three cooperating pieces, active only when a session sets
+``spark.rapids.sql.tpu.history.dir`` (and ``history.enabled`` stays
+true) — with the subsystem off, plans and behavior are byte-for-byte
+the history-free engine's:
+
+* :mod:`~spark_rapids_tpu.history.store` — the persistent JSONL
+  statistics store: one record of runtime facts per plan fingerprint,
+  appended at query end, read back lazily.  Stdlib-only so
+  ``tools/rapidshist.py`` can load it runtime-free.
+* :mod:`~spark_rapids_tpu.history.seeding` — history-seeded planning:
+  partition sizing, skew pre-split and the broadcast build side decided
+  up front from the previous run's record.
+* :mod:`~spark_rapids_tpu.history.fragcache` — the cross-query fragment
+  cache: materialized root fragments kept as catalog-registered
+  spillables; a repeat query re-executes zero dispatches.
+
+This module is the session-facing glue: ``begin_query`` (seed the plan,
+arm the fragment key on the ExecContext) and ``end_query`` (append the
+store record).  Both are single-conf-read no-ops when the subsystem is
+inactive, and ``end_query`` never lets a store IO failure fail the
+query that just produced rows.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from spark_rapids_tpu.history import store
+
+
+def history_dir(conf) -> Optional[str]:
+    """The active store directory, or None when the subsystem is off."""
+    from spark_rapids_tpu.config import HISTORY_DIR, HISTORY_ENABLED
+    d = HISTORY_DIR.get(conf)
+    if not d or not HISTORY_ENABLED.get(conf):
+        return None
+    return d
+
+
+def input_identity(plan) -> Optional[str]:
+    """Input-identity half of the fragment key: (path, mtime_ns, size)
+    per scanned file — an overwritten input invalidates the fragment —
+    and the id-stable batch holders for in-memory sources (sound because
+    the cache entry's lifetime is tied to the logical plan's liveness,
+    like serve/excache).  None (no caching) when an input went missing
+    or a source kind is unknown to this walk."""
+    from spark_rapids_tpu.plan.logical import FileScan, InMemoryScan, Range
+    parts = []
+
+    def rec(node) -> bool:
+        if isinstance(node, FileScan):
+            for p in node.paths:
+                try:
+                    st = os.stat(p)
+                except OSError:
+                    return False
+                parts.append(f"file:{p}:{st.st_mtime_ns}:{st.st_size}")
+        elif isinstance(node, InMemoryScan):
+            for b in node.batches:
+                parts.append(f"mem:{id(b):x}")
+        elif isinstance(node, Range):
+            parts.append(f"range:{node.start}:{node.end}:{node.step}")
+        return all(rec(c) for c in node.children)
+
+    if not rec(plan):
+        return None
+    return "|".join(parts)
+
+
+def begin_query(session, plan, phys, ctx) -> None:
+    """Arm the history hooks for one execution: consult the store to
+    seed the physical plan (once per plan object) and put the fragment
+    key on the context for collect_host/pipeline_collect."""
+    conf = session.conf
+    d = history_dir(conf)
+    if d is None:
+        return
+    from spark_rapids_tpu.config import (
+        HISTORY_FRAGMENTS_ENABLED, HISTORY_FRAGMENTS_MAX_BYTES,
+        HISTORY_FRAGMENTS_MAX_ENTRIES, HISTORY_MAX_AGE_SEC,
+        HISTORY_SEED_ENABLED, HISTORY_STORE_MAX_RECORDS,
+    )
+    from spark_rapids_tpu.plan.logical import plan_fingerprint
+    fp_hash = store.fingerprint_hash(plan_fingerprint(plan))
+    conf_sig = store.conf_signature(conf._settings.items())
+    ctx._history_dir = d
+    ctx._history_fp = fp_hash
+    ctx._history_conf_sig = conf_sig
+    if HISTORY_SEED_ENABLED.get(conf) and \
+            not getattr(phys, "_history_seeded", False):
+        # once per (process-shared) physical plan object: re-seeding a
+        # later run would change split shapes and recompile programs the
+        # first run already paid for
+        phys._history_seeded = True
+        ctx.metric("history", "statsStoreQueries").add(1)
+        rec = store.lookup(
+            d, fp_hash, conf_sig,
+            max_age_sec=HISTORY_MAX_AGE_SEC.get(conf),
+            max_records=HISTORY_STORE_MAX_RECORDS.get(conf))
+        if rec is not None:
+            from spark_rapids_tpu.history import seeding
+            seeding.seed(phys, rec, ctx)
+    if HISTORY_FRAGMENTS_ENABLED.get(conf) and session.runtime is not None:
+        from spark_rapids_tpu.history.fragcache import fragment_cache
+        fragment_cache().configure(
+            HISTORY_FRAGMENTS_MAX_ENTRIES.get(conf),
+            HISTORY_FRAGMENTS_MAX_BYTES.get(conf))
+        sig = input_identity(plan)
+        if sig is not None:
+            ctx._history_frag_key = (fp_hash, conf_sig, sig)
+
+
+def end_query(session, plan, phys, ctx, metrics: Dict[str, Any],
+              wall_ns: int, out) -> None:
+    """Append this query's record to the store (no-op when inactive; a
+    store IO failure never fails the query)."""
+    d = getattr(ctx, "_history_dir", None)
+    if d is None:
+        return
+    from spark_rapids_tpu.history import seeding
+    rec = seeding.harvest(phys, metrics, wall_ns,
+                          getattr(out, "num_rows", 0),
+                          ctx._history_fp, ctx._history_conf_sig)
+    try:
+        store.append(d, rec)
+    except OSError:
+        pass
+
+
+def runtime_stats() -> Dict[str, int]:
+    """Store + fragment-cache counters for the serve stats() rollup."""
+    out = dict(store.stats())
+    from spark_rapids_tpu.history.fragcache import fragment_cache
+    out.update(fragment_cache().stats())
+    return out

@@ -1,0 +1,239 @@
+"""Cross-query fragment cache (history/).
+
+A process-wide, fingerprint-keyed cache of materialized root fragments:
+when a whole-pipeline collect (plan/pipeline.pipeline_collect) finishes
+a query whose session runs with a history dir, the fresh device outputs
+are registered in the spill catalog (PRIORITY_FRAGMENT — the most
+spillable band, so cached fragments yield HBM before any live query
+data) and kept under a key of
+
+    (plan fingerprint hash, plan-relevant conf signature, input identity)
+
+where input identity is (path, mtime_ns, size) per scanned file and the
+id-stable in-memory holders for InMemoryScan sources.  A repeat query
+with the same key skips the whole subtree: ``collect_host`` serves the
+cached batches straight through D2H — zero dispatches, zero compiles,
+bit-identical rows (the cached device batches ARE the cold run's
+outputs; host<->device round trips through the spill tiers preserve
+them exactly).
+
+Entries are never pinned: the batches ride the device->host->disk spill
+tiers under catalog pressure like any other spillable, and the cache
+itself is LRU-bounded by entry count and payload bytes
+(``spark.rapids.sql.tpu.history.fragments.*``).  Each entry records the
+device generation it was built under; a device-lost recovery bumps the
+generation (runtime.device.DeviceRuntime.recover) and the next lookup
+drops the entry and recomputes from lineage — same contract as the
+exchange split cache.  Entry lifetime is also tied to the LOGICAL
+plan's liveness via weakref (exactly serve/excache's discipline), which
+keeps the id()-keyed parts of the fingerprint and input identity sound.
+
+Thread safety: bookkeeping under one lock; batch materialization,
+registration and victim closing run outside it.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from collections import OrderedDict
+from typing import Any, List, Optional, Tuple
+
+DEFAULT_MAX_ENTRIES = 64
+DEFAULT_MAX_BYTES = 256 << 20
+
+
+class _Fragment:
+    __slots__ = ("plan_ref", "handles", "generation", "nbytes")
+
+    def __init__(self, plan_ref, handles, generation, nbytes):
+        self.plan_ref = plan_ref
+        self.handles = handles
+        self.generation = generation
+        self.nbytes = nbytes
+
+
+class FragmentCache:
+    """LRU of materialized fragments, shared by every session."""
+
+    def __init__(self, max_entries: int = DEFAULT_MAX_ENTRIES,
+                 max_bytes: int = DEFAULT_MAX_BYTES):
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[Any, _Fragment]" = OrderedDict()
+        self._max_entries = max(1, int(max_entries))
+        self._max_bytes = int(max_bytes)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    @staticmethod
+    def _ref(plan: Any):
+        try:
+            return weakref.ref(plan)
+        except TypeError:
+            return lambda: plan
+
+    def configure(self, max_entries: int, max_bytes: int) -> None:
+        with self._lock:
+            self._max_entries = max(1, int(max_entries))
+            self._max_bytes = int(max_bytes)
+            victims = self._evict_locked()
+        self._close_all(victims)
+
+    # -- internal -----------------------------------------------------------
+
+    def _evict_locked(self) -> List[_Fragment]:
+        """Collect LRU victims past either bound (and dead-plan entries);
+        caller closes them OUTSIDE the lock."""
+        victims: List[_Fragment] = []
+        dead = [k for k, e in self._entries.items() if e.plan_ref() is None]
+        for k in dead:
+            victims.append(self._entries.pop(k))
+        total = sum(e.nbytes for e in self._entries.values())
+        while self._entries and (
+                len(self._entries) > self._max_entries
+                or total > max(0, self._max_bytes)):
+            _, ent = self._entries.popitem(last=False)
+            total -= ent.nbytes
+            victims.append(ent)
+            self.evictions += 1
+        return victims
+
+    @staticmethod
+    def _close_all(fragments: List[_Fragment]) -> None:
+        for ent in fragments:
+            for h in ent.handles:
+                h.close()
+
+    # -- public -------------------------------------------------------------
+
+    def fetch(self, key: Any, ctx) -> Optional[List]:
+        """Materialized device batches for ``key``, or None on miss.
+
+        A hit re-hydrates the cached handles (overlapped unspill via the
+        catalog prefetcher) WITHOUT taking device admission — the caller
+        only runs D2H on the result.  Generation mismatch or a
+        DeviceLostError during rehydration drops the entry (recompute
+        from lineage) and reports a miss."""
+        from spark_rapids_tpu.runtime.device import DeviceRuntime
+        gen_now = DeviceRuntime.generation()
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is not None and (ent.plan_ref() is None
+                                    or ent.generation != gen_now):
+                del self._entries[key]
+                self.misses += 1
+                stale = ent
+            elif ent is None:
+                self.misses += 1
+                return None
+            else:
+                self._entries.move_to_end(key)
+                stale = None
+        if stale is not None:
+            self._close_all([stale])
+            return None
+        from spark_rapids_tpu.plan.physical import prefetch_spillables
+        try:
+            devs = list(prefetch_spillables(ent.handles, depth=1))
+        except Exception:
+            # DeviceLostError (generation raced past the check), a handle
+            # closed by a concurrent eviction, an unspill failure — every
+            # rehydration failure degrades the same way: drop the entry
+            # and let the caller recompute from lineage
+
+            with self._lock:
+                if self._entries.get(key) is ent:
+                    del self._entries[key]
+                self.misses += 1
+            self._close_all([ent])
+            return None
+        with self._lock:
+            self.hits += 1
+        ctx.metric("history", "fragmentCacheHits").add(1)
+        ctx.metric("history", "fragmentCacheBytes").add(ent.nbytes)
+        from spark_rapids_tpu.obs import events as obs_events
+        obs_events.emit_instant("history", "fragment_hit", "history",
+                                bytes=ent.nbytes, batches=len(devs))
+        return devs
+
+    def insert(self, key: Any, plan: Any, outs: List, ctx) -> bool:
+        """Adopt a finished collect's device outputs under ``key``.
+
+        Registers every batch as a catalog spillable (PRIORITY_FRAGMENT)
+        so the payload rides the spill tiers under pressure; first
+        insert wins on a race.  Returns False when insertion is
+        disabled (maxBytes <= 0) or the key is already cached."""
+        from spark_rapids_tpu.runtime.device import DeviceRuntime
+        with self._lock:
+            if self._max_bytes <= 0:
+                return False
+            ent = self._entries.get(key)
+            if ent is not None and ent.plan_ref() is not None:
+                return False
+        cat = DeviceRuntime.get(ctx.conf).catalog
+        from spark_rapids_tpu.mem.catalog import (
+            PRIORITY_FRAGMENT, device_batch_bytes,
+        )
+        handles = []
+        nbytes = 0
+        for b in outs:
+            nbytes += device_batch_bytes(b)
+            handles.append(cat.register(b, priority=PRIORITY_FRAGMENT))
+        ent = _Fragment(self._ref(plan), handles,
+                        DeviceRuntime.generation(), nbytes)
+        with self._lock:
+            prior = self._entries.get(key)
+            if prior is not None and prior.plan_ref() is not None:
+                loser: Optional[_Fragment] = ent  # racer won; drop ours
+                victims: List[_Fragment] = []
+            else:
+                if prior is not None:
+                    self._entries.pop(key)
+                    victims = [prior]
+                else:
+                    victims = []
+                loser = None
+                self._entries[key] = ent
+                self._entries.move_to_end(key)
+                victims.extend(self._evict_locked())
+        if loser is not None:
+            self._close_all([loser])
+            return False
+        self._close_all(victims)
+        return True
+
+    def drop(self, key: Any) -> None:
+        with self._lock:
+            ent = self._entries.pop(key, None)
+        if ent is not None:
+            self._close_all([ent])
+
+    def clear(self) -> None:
+        with self._lock:
+            victims = list(self._entries.values())
+            self._entries.clear()
+        self._close_all(victims)
+
+    def stats(self):
+        with self._lock:
+            return {
+                "fragment_cache_entries": len(self._entries),
+                "fragment_cache_bytes": sum(
+                    e.nbytes for e in self._entries.values()),
+                "fragment_cache_hits": self.hits,
+                "fragment_cache_misses": self.misses,
+                "fragment_cache_evictions": self.evictions,
+            }
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
+
+
+_SHARED: FragmentCache = FragmentCache()
+
+
+def fragment_cache() -> FragmentCache:
+    """The process singleton (serve/excache.shared_plan_cache analogue)."""
+    return _SHARED

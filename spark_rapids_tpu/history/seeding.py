@@ -1,0 +1,159 @@
+"""History-seeded planning (history/).
+
+AQE v1 (plan/adaptive) re-discovers partition sizing, skew and the
+broadcast build side from runtime statistics on EVERY run; this module
+makes those decisions up front from the statistics store's record of a
+previous run of the same (plan fingerprint, conf signature):
+
+* **Shuffle partition sizing**: an exchange whose recorded total bytes
+  fit in fewer partitions than the static count gets its partitioning
+  right-sized to ``ceil(bytes / coalesce target)`` before the split
+  runs — the first shuffle produces the coalesced layout directly, so
+  runtime coalescing has nothing left to merge (this is also the
+  bucket-policy lever: fewer partition counts means fewer compiled
+  split shapes).  Hash/round-robin only; range needs its sampled
+  bounds and mesh/collapse-local exchanges don't split by count.
+* **Skew pre-split**: recorded per-partition bytes that flag as skewed
+  under the adaptive thresholds mark the exchange
+  (``_history_skew``); the consuming join ORs the marks into
+  plan_groups' runtime flags, so the skewed partition is isolated and
+  chunk-streamed from the first run.
+* **Broadcast build side**: a join that switched to broadcast last run
+  records the winning side; the hint (``_history_bc_side``) reorders
+  the side probe so the switch materializes the right exchange first.
+
+Every applied decision bumps ``historySeededDecisions`` and emits an
+obs instant (site ``history``).  Seeding runs AT MOST ONCE per physical
+plan object (the plan is process-shared via serve/excache — re-seeding
+a later execution would change split shapes and recompile), and a
+stats-absent or stats-stale store seeds nothing: the plan stays
+byte-for-byte the unseeded one.
+
+Harvest is the write half: after a query the session folds the facts
+the engine already holds on the host (per-exchange ``_last_part_*``
+recorded by the shuffle split's one bulk sync, the join's switch cache,
+the metrics frame) into one store record — zero extra device syncs.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import List, Tuple
+
+
+def _preorder(op) -> List[Tuple[str, object]]:
+    """(path, op) per node, path = ``<preorder index>:<type name>`` —
+    stable across processes for one (fingerprint, conf) plan shape."""
+    out: List[Tuple[str, object]] = []
+
+    def rec(node):
+        out.append((f"{len(out)}:{type(node).__name__}", node))
+        for c in node.children:
+            rec(c)
+
+    rec(op)
+    return out
+
+
+def _note(ctx, op_id: str, mechanism: str, **fields) -> None:
+    ctx.metric(op_id, "historySeededDecisions").add(1)
+    from spark_rapids_tpu.obs import events as obs_events
+    obs_events.emit_instant("history", mechanism, op_id, **fields)
+
+
+def seed(phys, record: dict, ctx) -> int:
+    """Apply a store record's decisions to ``phys``; returns how many
+    were applied.  Mutations are confined to the physical plan (a copied
+    partitioning object, hint attributes) — the logical plan and its
+    fingerprint are untouched."""
+    from spark_rapids_tpu.ops.tpu_exec import TpuShuffledHashJoinExec
+    from spark_rapids_tpu.parallel.exchange import TpuShuffleExchangeExec
+    from spark_rapids_tpu.parallel.partitioning import (
+        HashPartitioning, RoundRobinPartitioning,
+    )
+    from spark_rapids_tpu.plan import adaptive as _adaptive
+    exchanges = {e.get("path"): e for e in record.get("exchanges", ())}
+    joins = {j.get("path"): j for j in record.get("joins", ())}
+    applied = 0
+    for path, op in _preorder(phys):
+        if isinstance(op, TpuShuffleExchangeExec):
+            rec = exchanges.get(path)
+            if rec is None:
+                continue
+            sizes = rec.get("bytes") or []
+            if op._mesh_active(ctx) or op._collapse_local(ctx):
+                continue
+            n = op.partitioning.num_partitions
+            if len(sizes) != n or n <= 1:
+                continue
+            target = max(1, _adaptive.target_bytes(ctx))
+            want = max(1, -(-sum(sizes) // target))  # ceil
+            if want < n and isinstance(
+                    op.partitioning,
+                    (HashPartitioning, RoundRobinPartitioning)):
+                # copy before mutating: partitioning objects can be
+                # shared with the logical plan, and the fingerprint must
+                # keep describing the UNSEEDED shape
+                p = copy.copy(op.partitioning)
+                p.num_partitions = want
+                op.partitioning = p
+                _note(ctx, op.op_id, "seed_partitions",
+                      before=n, after=want)
+                applied += 1
+            else:
+                flags = _adaptive.skew_flags(ctx, list(sizes), "bytes")
+                if any(flags):
+                    op._history_skew = flags
+                    _note(ctx, op.op_id, "seed_skew",
+                          partitions=sum(1 for f in flags if f))
+                    applied += 1
+        elif isinstance(op, TpuShuffledHashJoinExec):
+            rec = joins.get(path)
+            side = rec.get("bc_side") if rec else None
+            if side in ("left", "right"):
+                op._history_bc_side = side
+                _note(ctx, op.op_id, "seed_broadcast", side=side)
+                applied += 1
+    return applied
+
+
+def harvest(phys, metrics: dict, wall_ns: int, out_rows: int,
+            fp_hash: str, conf_sig: str) -> dict:
+    """Fold one finished query's host-known runtime facts into a store
+    record (history.store schema v1)."""
+    from spark_rapids_tpu.ops.tpu_exec import TpuShuffledHashJoinExec
+    from spark_rapids_tpu.parallel.exchange import TpuShuffleExchangeExec
+    exchanges = []
+    joins = []
+    for path, op in _preorder(phys):
+        if isinstance(op, TpuShuffleExchangeExec):
+            rows = getattr(op, "_last_part_rows", None)
+            nbytes = getattr(op, "_last_part_bytes", None)
+            if rows is None and nbytes is None:
+                continue
+            exchanges.append({
+                "path": path,
+                "parts": len(nbytes if nbytes is not None else rows),
+                "rows": [int(v) for v in rows] if rows else [],
+                "bytes": [int(v) for v in nbytes] if nbytes else [],
+            })
+        elif isinstance(op, TpuShuffledHashJoinExec):
+            cached = getattr(op, "_switch_cache", None)
+            if cached is not None:
+                joins.append({"path": path, "bc_side": cached[2]})
+
+    def m(key):
+        return int(metrics.get(key, 0) or 0)
+
+    return {
+        "fp": fp_hash,
+        "conf_sig": conf_sig,
+        "wall_ns": int(wall_ns),
+        "out_rows": int(out_rows),
+        "compile_count": m("compileCount"),
+        "compile_wall_ns": m("compileWallNs"),
+        "spill_host_bytes": m("spillToHostBytes"),
+        "spill_disk_bytes": m("spillToDiskBytes"),
+        "exchanges": exchanges,
+        "joins": joins,
+    }

@@ -72,6 +72,10 @@ DEVICE_SPILL_BUDGET = conf_bytes(
 PRIORITY_INPUT = 0
 PRIORITY_SHUFFLE_OUTPUT = -1000
 PRIORITY_ON_DECK = 1000
+# Cross-query fragment-cache entries (history.fragcache): the MOST
+# spillable band — a cached fragment is a speculative reuse bet and must
+# yield HBM before any live query's inputs or shuffle outputs.
+PRIORITY_FRAGMENT = -2000
 
 #: Bounded wait slice (seconds) for every blocking loop in this module:
 #: notify still wakes immediately, the bound only caps the C-level block so

@@ -946,9 +946,16 @@ class TpuShuffledHashJoinExec(TpuExec):
                          for lp, rp in zip(lparts, rparts)]
                 unit = "rows"
                 record = False  # these sizes cost a fetch, not free stats
+            # history-seeded skew marks recorded on either exchange by a
+            # previous run (history.seeding) isolate known-hot
+            # partitions before this run's stats would
+            seed = getattr(lchild, "_history_skew", None)
+            if seed is None:
+                seed = getattr(rchild, "_history_skew", None)
             groups, skew_flags = _adaptive.plan_groups(
                 ctx, self.op_id, list(zip(lparts, rparts)), sizes, unit,
-                record=record, detect_skew=self.how != "full")
+                record=record, detect_skew=self.how != "full",
+                seed_flags=seed)
             lparts = [itertools.chain(*(lp for lp, _ in g))
                       for g in groups]
             rparts = [itertools.chain(*(rp for _, rp in g))
@@ -989,7 +996,14 @@ class TpuShuffledHashJoinExec(TpuExec):
         if thr < 0:
             return None
         lchild, rchild = self.children
-        for side in _adaptive.broadcast_build_sides(self.how):
+        sides = _adaptive.broadcast_build_sides(self.how)
+        hint = getattr(self, "_history_bc_side", None)
+        if hint in sides:
+            # history-seeded build side (history.seeding): try the side
+            # that won last run first, so the switch materializes the
+            # right exchange without probing the other side
+            sides = [hint] + [s for s in sides if s != hint]
+        for side in sides:
             build = rchild if side == "right" else lchild
             probe = lchild if side == "right" else rchild
             bparts = build.partitions(ctx)

@@ -176,7 +176,8 @@ def skew_flags(ctx, sizes: List[int], unit: str) -> List[bool]:
 
 
 def plan_groups(ctx, op_id: str, items: List, sizes: List[int],
-                unit: str, record: bool = True, detect_skew: bool = True
+                unit: str, record: bool = True, detect_skew: bool = True,
+                seed_flags: Optional[List[bool]] = None
                 ) -> Tuple[List[List], List[bool]]:
     """The coalescing planner: group adjacent small partitions to the
     target while keeping skewed partitions ALONE (a hot partition merged
@@ -189,10 +190,16 @@ def plan_groups(ctx, op_id: str, items: List, sizes: List[int],
     (aqeStats* counts only zero-cost, already-known statistics).
     ``detect_skew=False`` disables isolation for consumers that cannot
     act on a skewed partition anyway (a full outer join must see the
-    whole pair at once)."""
+    whole pair at once).  ``seed_flags`` are history-seeded skew marks
+    (history.seeding, from a previous run's recorded sizes): OR-ed into
+    the runtime detection so a known-hot partition is isolated up front
+    even when this run's stats alone would not flag it."""
     target = target_for(ctx, unit)
     flags = skew_flags(ctx, sizes, unit) if detect_skew \
         else [False] * len(sizes)
+    if seed_flags is not None and detect_skew \
+            and len(seed_flags) == len(flags):
+        flags = [a or b for a, b in zip(flags, seed_flags)]
     groups: List[List] = []
     gflags: List[bool] = []
     cur: List = []

@@ -596,6 +596,15 @@ def pipeline_collect(root: PhysicalOp, ctx: ExecContext
             _release_admission(ctx, getattr(ctx, "_pipeline_h2d", 0))
         else:
             ctx._pipeline_h2d = 0
+    frag_key = getattr(ctx, "_history_frag_key", None)
+    if frag_key is not None and getattr(ctx, "logical_plan", None) is not None:
+        # adopt the outputs into the cross-query fragment cache
+        # (history.fragcache) AFTER the D2H landed: registering first
+        # would let budget pressure spill a batch mid-transfer.  Only
+        # this path inserts — its outs are always fresh jitted-program
+        # outputs, never aliases of cached source batches.
+        from spark_rapids_tpu.history.fragcache import fragment_cache
+        fragment_cache().insert(frag_key, ctx.logical_plan, outs, ctx)
     if not hbs:
         from spark_rapids_tpu.plan.physical import _empty_host_col
         return HostBatch(root.output_schema, [

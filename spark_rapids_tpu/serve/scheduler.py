@@ -494,4 +494,8 @@ class ServeScheduler:
                 "tenants": tenants,
             }
         out.update(shared_plan_cache().stats())
+        # query-intelligence rollup (history/): the statistics store the
+        # serving runtime warms for tenant N+1, plus fragment-cache reuse
+        from spark_rapids_tpu.history import runtime_stats
+        out.update(runtime_stats())
         return out

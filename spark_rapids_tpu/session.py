@@ -227,6 +227,11 @@ class TpuSparkSession:
         obs_token = obs_events.begin_query(
             enabled=OBS_ENABLED.get(self.conf),
             max_events=OBS_RING_MAX_EVENTS.get(self.conf))
+        # query-intelligence hooks (history/): seed the plan from the
+        # statistics store and arm the fragment-cache key on the context
+        # — a single conf read when no history dir is configured
+        from spark_rapids_tpu import history as qhistory
+        qhistory.begin_query(self, plan, phys, ctx)
         # (re)install the deterministic fault registry per query (on the
         # scope just opened, so concurrent queries keep separate specs):
         # call counters reset so "the Nth dispatch" is query-relative;
@@ -359,6 +364,18 @@ class TpuSparkSession:
                  if "aqeEstimateErrorPct" in ms]
         frame.last_metrics["aqeEstimateErrorPct"] = \
             sum(_errs) / len(_errs) if _errs else 0.0
+        # query-intelligence economics (history/): planning decisions the
+        # store seeded up front, fragment-cache reuse (a hit re-executes
+        # the whole subtree with ZERO dispatches), and how often the
+        # persistent store was consulted
+        frame.last_metrics["historySeededDecisions"] = _scan_sum(
+            "historySeededDecisions")
+        frame.last_metrics["fragmentCacheHits"] = _scan_sum(
+            "fragmentCacheHits")
+        frame.last_metrics["fragmentCacheBytes"] = _scan_sum(
+            "fragmentCacheBytes")
+        frame.last_metrics["statsStoreQueries"] = _scan_sum(
+            "statsStoreQueries")
         # fault-tolerance economics (fault.metrics deltas): recovery
         # replays, deterministic-backoff wall, device losses handled,
         # partitions completed via the CPU path, and injected faults
@@ -399,6 +416,11 @@ class TpuSparkSession:
         # self.last_metrics sees the previous complete dict or this one,
         # never a half-filled frame
         self.last_metrics = frame.last_metrics
+        # persist this query's runtime facts for future plan seeding
+        # (history.store; no-op without a history dir, independent of
+        # the obs bus so a history-only session still learns)
+        qhistory.end_query(self, plan, phys, ctx, frame.last_metrics,
+                           time.monotonic_ns() - t_query0, out)
         if obs_token is not None and obs_token.bus is not None:
             self._record_profile(obs_token.query_id, obs_events_list,
                                  obs_dropped,

@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""rapidshist — inspect and prune the query-intelligence statistics store.
+
+Usage:
+    python tools/rapidshist.py <history-dir> [--fingerprint FP]
+        [--prune N] [--json]
+
+Reads the JSONL statistics store a session wrote under
+``spark.rapids.sql.tpu.history.dir`` (history/store.py schema) and
+prints, per plan fingerprint: record age, query wall, compile economics,
+spill pressure, and the per-exchange partition layout that seeds the
+next run's plan.  ``--prune N`` rewrites the store keeping the newest
+record per fingerprint, bounded to the N newest overall.
+
+Runtime-free by construction (the same loading discipline as
+``rapidslint``/``rapidsprof``): ``history/store.py`` is stdlib-only and
+loaded standalone without executing the engine's root ``__init__``, so
+a store written on a TPU host inspects and prunes on any laptop.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_store():
+    """Load spark_rapids_tpu.history.store WITHOUT the engine package
+    __init__ (which imports jax) — the store module is stdlib-only with
+    no package-relative imports precisely for this."""
+    path = os.path.join(REPO_ROOT, "spark_rapids_tpu", "history",
+                        "store.py")
+    spec = importlib.util.spec_from_file_location("rapidshist_store", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["rapidshist_store"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+store = _load_store()
+
+
+def _age(ts: float) -> str:
+    d = max(0.0, time.time() - ts)
+    if d < 120:
+        return f"{d:.0f}s"
+    if d < 7200:
+        return f"{d / 60:.0f}m"
+    if d < 172800:
+        return f"{d / 3600:.1f}h"
+    return f"{d / 86400:.1f}d"
+
+
+def _mb(n: int) -> str:
+    return f"{n / (1 << 20):.2f} MB"
+
+
+def describe(rec: dict) -> str:
+    lines = [
+        f"fingerprint {rec.get('fp')}  (conf {rec.get('conf_sig')}, "
+        f"age {_age(float(rec.get('ts', 0) or 0))})",
+        f"  wall {float(rec.get('wall_ns', 0)) / 1e6:.2f} ms, "
+        f"{rec.get('out_rows', 0)} rows out, "
+        f"compiles {rec.get('compile_count', 0)} "
+        f"({float(rec.get('compile_wall_ns', 0)) / 1e6:.1f} ms)",
+    ]
+    sp_h = int(rec.get("spill_host_bytes", 0) or 0)
+    sp_d = int(rec.get("spill_disk_bytes", 0) or 0)
+    if sp_h or sp_d:
+        lines.append(f"  spill pressure: {_mb(sp_h)} to host, "
+                     f"{_mb(sp_d)} to disk")
+    for ex in rec.get("exchanges", ()):
+        sizes = ex.get("bytes") or ex.get("rows") or []
+        unit = "B" if ex.get("bytes") else "rows"
+        total = sum(sizes)
+        mx = max(sizes) if sizes else 0
+        lines.append(
+            f"  exchange {ex.get('path')}: {ex.get('parts')} partitions, "
+            f"total {total} {unit}, max {mx} {unit}")
+    for jn in rec.get("joins", ()):
+        lines.append(f"  join {jn.get('path')}: broadcast build side = "
+                     f"{jn.get('bc_side')}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="inspect/prune the spark_rapids_tpu statistics store")
+    ap.add_argument("dir", help="history dir "
+                    "(spark.rapids.sql.tpu.history.dir)")
+    ap.add_argument("--fingerprint", default=None,
+                    help="restrict to one plan fingerprint hash")
+    ap.add_argument("--prune", type=int, default=None, metavar="N",
+                    help="rewrite the store keeping the N newest records "
+                    "(newest per fingerprint always wins)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the folded records as JSON")
+    args = ap.parse_args(argv)
+
+    if args.prune is not None:
+        before, after = store.prune(args.dir, args.prune)
+        print(f"pruned {store.store_path(args.dir)}: "
+              f"{before} -> {after} records")
+        return 0
+
+    records = store.load(args.dir)
+    if args.fingerprint is not None:
+        records = {fp: r for fp, r in records.items()
+                   if fp == args.fingerprint}
+    if not records:
+        print("no records found in", store.store_path(args.dir))
+        return 2
+    if args.json:
+        print(json.dumps(records, indent=2, sort_keys=True))
+        return 0
+    recs = sorted(records.values(),
+                  key=lambda r: float(r.get("ts", 0) or 0), reverse=True)
+    print(f"{len(recs)} plan fingerprint(s) in "
+          f"{store.store_path(args.dir)}\n")
+    for rec in recs:
+        print(describe(rec))
+        print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -133,6 +133,21 @@ def report(profiles, top_n: int = 10) -> str:
     lines.append("  metrics: " + ", ".join(
         f"{k}={v}" for k, v in rmetrics.items()))
 
+    # query-intelligence summary (history/): seeded decisions and
+    # fragment-cache reuse recorded by the sessions that wrote these logs
+    hist_events = sum(p.site("history")["count"] for p in profiles)
+    hmetrics = {"historySeededDecisions": 0, "fragmentCacheHits": 0,
+                "fragmentCacheBytes": 0, "statsStoreQueries": 0}
+    for p in profiles:
+        for k in hmetrics:
+            hmetrics[k] += int(p.metrics.get(k, 0) or 0)
+    if hist_events or any(hmetrics.values()):
+        lines.append("")
+        lines.append("== query intelligence (history) ==")
+        lines.append(f"  history events {hist_events}")
+        lines.append("  metrics: " + ", ".join(
+            f"{k}={v}" for k, v in hmetrics.items()))
+
     # per-query comparison
     if len(profiles) > 1:
         lines.append("")
