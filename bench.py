@@ -195,6 +195,30 @@ def time_engine(tpu_enabled: bool, data, runs: int = 3,
         if best_off > 0 and best_off != float("inf"):
             obs_overhead_pct = round(100.0 * (best - best_off) / best_off,
                                      2)
+    telemetry_overhead_pct = 0.0
+    if econ_detail:
+        # telemetry-off timed loop, same compiled plan (obs.* confs are
+        # excluded from the plan-cache fingerprint): best-on vs best-off
+        # wall IS the continuous aggregation ring's cost
+        s.set_conf("spark.rapids.sql.tpu.obs.telemetry.enabled", False)
+        best_tel_off = float("inf")
+        for _ in range(runs):
+            t0 = time.monotonic()
+            q.collect()
+            best_tel_off = min(best_tel_off, time.monotonic() - t0)
+        s.set_conf("spark.rapids.sql.tpu.obs.telemetry.enabled", True)
+        if best_tel_off > 0 and best_tel_off != float("inf"):
+            telemetry_overhead_pct = round(
+                100.0 * (best - best_tel_off) / best_tel_off, 2)
+    # critical-path attribution of the newest profiled run: which site
+    # dominates the exact wall decomposition (obs.critpath)
+    critpath_top_site = ""
+    hist = s.query_history()
+    if hist:
+        from spark_rapids_tpu.obs import critpath as obs_critpath
+        cp = obs_critpath.from_profile(hist[-1])
+        if cp is not None:
+            critpath_top_site = cp.top_site()
     econ = {
         "compile_s": round(warm.get("compileWallNs", 0) / 1e9, 3),
         "compile_count": warm.get("compileCount", 0),
@@ -227,6 +251,8 @@ def time_engine(tpu_enabled: bool, data, runs: int = 3,
         # obs-off loop above; negative values are run-to-run noise)
         "obs_event_count": repeat.get("obsEventCount", 0),
         "obs_overhead_pct": obs_overhead_pct,
+        "telemetry_overhead_pct": telemetry_overhead_pct,
+        "critpath_top_site": critpath_top_site,
     }
     return best, econ
 
@@ -528,8 +554,9 @@ def time_history():
     compile-free (the plan's programs are warmed first); the cold run
     re-executes the whole subtree, the warm run serves it from the
     cross-query fragment cache — the ratio is pure fragment-reuse
-    speedup.  Returns (warm speedup, fragmentCacheHits of the warm
-    run)."""
+    speedup.  Returns (warm speedup, fragmentCacheHits of the warm run,
+    regressionAlerts of the warm run — the sentinel must stay silent on
+    a run that got FASTER)."""
     import shutil
     import tempfile
 
@@ -560,9 +587,10 @@ def time_history():
         warm = q.collect()  # fragment-cache hit
         warm_wall = time.monotonic() - t0
         hits = s.last_metrics.get("fragmentCacheHits", 0)
+        alerts = s.last_metrics.get("regressionAlerts", 0)
         assert sorted(cold) == sorted(warm), "history warm/cold parity"
         speedup = round(cold_wall / warm_wall, 3) if warm_wall else 0.0
-        return speedup, hits
+        return speedup, hits, alerts
     finally:
         shutil.rmtree(hist_dir, ignore_errors=True)
 
@@ -753,7 +781,7 @@ def main():
     spill_gbps, spill_sync_gbps, spill_speedup, spill_depth = time_spill()
     aqe_rps, aqe_speedup, aqe_parity, aqe_counters = time_adaptive()
     serve = time_serve()
-    history_speedup, history_hits = time_history()
+    history_speedup, history_hits, history_alerts = time_history()
     mesh_curve, mesh_ratio, mesh_backend = time_mesh()
 
     data_bytes = ROWS * _bytes_per_row(data)
@@ -819,6 +847,15 @@ def main():
         # the measured wall cost of the always-on event bus
         "obs_event_count": tpu_econ["obs_event_count"],
         "obs_overhead_pct": tpu_econ["obs_overhead_pct"],
+        # obs v2 economics: the continuous telemetry ring's measured wall
+        # cost (same A/B discipline as obs_overhead_pct), the site the
+        # exact critical-path decomposition blames for the steady-state
+        # run, and the regression sentinel's alert count on the history
+        # lane's warm run (must be 0 — getting faster is not a
+        # regression)
+        "telemetry_overhead_pct": tpu_econ["telemetry_overhead_pct"],
+        "critpath_top_site": tpu_econ["critpath_top_site"],
+        "regression_alerts": history_alerts,
         # serving runtime economics (serve/): steady-state scheduler
         # throughput/latency on the weighted two-tenant template
         # workload, the coalesced-query count, served-vs-serial wall

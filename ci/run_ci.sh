@@ -77,8 +77,14 @@ for key in ("value", "donated_bytes", "h2d_gb_per_sec", "d2h_gb_per_sec",
             "scan_h2d_overlap_pct", "scan_chunks_skipped",
             "scan_v2_vs_v1", "mesh_rows_per_sec_by_devices",
             "mesh_spmd_vs_hostdriven", "mesh_backend",
-            "history_warm_speedup", "fragment_cache_hits"):
+            "history_warm_speedup", "fragment_cache_hits",
+            "telemetry_overhead_pct", "critpath_top_site",
+            "regression_alerts"):
     assert key in j, f"bench JSON missing {key}: {sorted(j)}"
+assert isinstance(j["critpath_top_site"], str) and j["critpath_top_site"], j
+assert isinstance(j["telemetry_overhead_pct"], float), j
+assert isinstance(j["regression_alerts"], int) and \
+    j["regression_alerts"] >= 0, j
 assert j["value"] > 0, j
 assert j["scan_gb_per_sec"] > 0, j
 assert j["spill_gb_per_sec"] > 0, j
@@ -174,6 +180,124 @@ print("obs smoke ok:", {
     "events": s.last_metrics["obsEventCount"],
     "dropped": s.last_metrics["obsEventsDropped"],
     "trace_events": len(tdoc["traceEvents"])})
+PY
+
+echo "== telemetry smoke: flushed JSONL -> rapidstop --once renders >=1"
+echo "   interval with nonzero dispatch wall, Prometheus export parses"
+python - << 'PY'
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.session import TpuSparkSession
+
+log_dir = tempfile.mkdtemp(prefix="rapids_telemetry_smoke_")
+s = TpuSparkSession(RapidsConf({
+    "spark.rapids.sql.enabled": True,
+    "spark.rapids.sql.tpu.obs.eventLogDir": log_dir,
+    "spark.rapids.sql.tpu.obs.telemetry.intervalMs": 25,
+}))
+df = s.create_dataframe(
+    {"k": [i % 7 for i in range(8192)], "v": list(range(8192))},
+    num_partitions=2)
+q = df.group_by("k").sum("v")
+q.collect()
+time.sleep(0.06)  # let the open interval's window pass
+q.collect()       # the flush at query end writes the completed intervals
+assert s.last_metrics["telemetryIntervals"] >= 1, s.last_metrics
+tpath = os.path.join(log_dir, f"telemetry-{os.getpid()}.jsonl")
+assert os.path.exists(tpath), os.listdir(log_dir)
+
+out = subprocess.run(
+    [sys.executable, "tools/rapidstop.py", tpath, "--once"],
+    capture_output=True, text=True, timeout=300)
+assert out.returncode == 0, f"rapidstop failed:\n{out.stdout}{out.stderr}"
+assert "telemetry:" in out.stdout, out.stdout
+assert "dispatch" in out.stdout, out.stdout
+
+prom = subprocess.run(
+    [sys.executable, "tools/rapidstop.py", tpath, "--prom"],
+    capture_output=True, text=True, timeout=300)
+assert prom.returncode == 0, prom.stderr
+wall = 0
+for line in prom.stdout.strip().splitlines():
+    if line.startswith("#"):
+        assert line.split()[1] == "TYPE", line
+        continue
+    name, val = line.rsplit(" ", 1)
+    float(val)  # every sample parses
+    if name == 'rapids_site_wall_ns_total{site="dispatch"}':
+        wall = float(val)
+assert wall > 0, f"no dispatch wall in Prometheus export:\n{prom.stdout}"
+print("telemetry smoke ok:", {
+    "intervals": s.last_metrics["telemetryIntervals"],
+    "dispatch_wall_ms": round(wall / 1e6, 2)})
+PY
+
+echo "== sentinel smoke: injected dispatch:slow regression must flag"
+echo "   regressionAlerts > 0 against a clean baseline; a clean repeat"
+echo "   must flag none; aggregates visible via rapidshist --json"
+python - << 'PY'
+import json
+import shutil
+import subprocess
+import sys
+import tempfile
+
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.session import TpuSparkSession
+
+hist_dir = tempfile.mkdtemp(prefix="rapids_sentinel_smoke_")
+try:
+    s = TpuSparkSession(RapidsConf({
+        "spark.rapids.sql.enabled": True,
+        "spark.rapids.sql.tpu.history.dir": hist_dir,
+        # re-execute warm repeats so the injected fault actually fires,
+        # and keep the plan fingerprint identical run over run
+        "spark.rapids.sql.tpu.history.fragments.enabled": False,
+        "spark.rapids.sql.tpu.history.seed.enabled": False,
+        # preset so toggling the spec off restores this exact conf
+        # state and the clean repeat reuses the cached plan (an absent->
+        # empty transition would replan and recompile, inflating wall)
+        "spark.rapids.sql.tpu.faults.spec": "",
+    }))
+    df = s.create_dataframe(
+        {"k": [i % 7 for i in range(4096)], "v": list(range(4096))},
+        num_partitions=2)
+    q = df.group_by("k").sum("v")
+    for _ in range(4):
+        q.collect()
+        assert s.last_metrics["regressionAlerts"] == 0, s.last_metrics
+    # faults. confs are excluded from the conf signature: the slow run
+    # is judged against the clean baseline it just built
+    s.conf.set("spark.rapids.sql.tpu.faults.spec",
+               "dispatch:slow=500ms@1+")
+    q.collect()
+    m = dict(s.last_metrics)
+    assert m["faultsInjected"] >= 1, m
+    assert m["regressionAlerts"] > 0, m
+    s.conf.set("spark.rapids.sql.tpu.faults.spec", "")
+    q.collect()
+    assert s.last_metrics["regressionAlerts"] == 0, s.last_metrics
+
+    out = subprocess.run(
+        [sys.executable, "tools/rapidshist.py", hist_dir, "--json"],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr
+    recs = json.loads(out.stdout)
+    aggs = [r["agg"] for r in recs.values() if r.get("agg")]
+    assert aggs and aggs[0]["n"] >= 4, recs
+    assert "median" in aggs[0]["keys"]["wall_ns"], aggs[0]
+    print("sentinel smoke ok:", {
+        "alerts": m["regressionAlerts"],
+        "baseline_runs": aggs[0]["n"],
+        "wall_median_ms": round(
+            aggs[0]["keys"]["wall_ns"]["median"] / 1e6, 2)})
+finally:
+    shutil.rmtree(hist_dir, ignore_errors=True)
 PY
 
 echo "== history smoke: same aggregation twice against a fresh history"

@@ -555,6 +555,24 @@ OBS_EVENT_LOG_DIR = conf_str(
     "When set, each query appends its profile header + events as JSONL "
     "to <dir>/events-<pid>.jsonl (the Spark event-log analogue), the "
     "input to tools/rapidsprof.py.  Empty disables the log.")
+OBS_TELEMETRY_ENABLED = conf_bool(
+    "spark.rapids.sql.tpu.obs.telemetry.enabled", True,
+    "Continuous time-series telemetry (obs.timeseries): every obs span "
+    "also folds into a process-wide fixed-interval aggregation ring "
+    "(per-site count/wall/bytes plus sampled gauges), exported as "
+    "Prometheus-style text and JSONL flushes to obs.eventLogDir "
+    "(telemetry-<pid>.jsonl, the tools/rapidstop.py input).  Disabled "
+    "cost is one branch per emit.")
+OBS_TELEMETRY_INTERVAL_MS = conf_int(
+    "spark.rapids.sql.tpu.obs.telemetry.intervalMs", 1000,
+    "Width of one telemetry aggregation interval: spans landing in the "
+    "same wall-clock bucket fold into one ring entry.  Smaller values "
+    "give rapidstop finer live resolution at more ring turnover.")
+OBS_TELEMETRY_MAX_INTERVALS = conf_int(
+    "spark.rapids.sql.tpu.obs.telemetry.maxIntervals", 512,
+    "Completed intervals the telemetry ring retains (drop-OLDEST past "
+    "the bound — unlike the per-query event ring, the live view must "
+    "keep the newest data; drops are counted and exported as a gauge).")
 SERVE_MAX_CONCURRENCY = conf_int(
     "spark.rapids.sql.tpu.serve.maxConcurrency", 2,
     "Runner threads the serving scheduler (serve.scheduler) drives "
@@ -641,6 +659,32 @@ HISTORY_FRAGMENTS_MAX_BYTES = conf_bytes(
     "Byte bound on fragment-cache residency (sum of cached batch "
     "payloads across tiers); inserting past it evicts least-recently-"
     "hit fragments first.  0 disables insertion.")
+HISTORY_AGGREGATE_RUNS = conf_int(
+    "spark.rapids.sql.tpu.history.aggregateRuns", 8,
+    "Runs per plan fingerprint the statistics store folds into its "
+    "robust aggregate (median/MAD of wall, dispatches, compiles, "
+    "spill/shuffle bytes) — the regression sentinel's baseline and the "
+    "ROADMAP 'aggregated over N runs instead of newest-wins' record "
+    "shape.  Seeding still reads the newest record.")
+SENTINEL_ENABLED = conf_bool(
+    "spark.rapids.sql.tpu.sentinel.enabled", True,
+    "Cross-run regression sentinel (obs.sentinel): each query's fresh "
+    "metrics are compared against the history store's median/MAD "
+    "aggregate for its plan fingerprint; a guarded key outside its band "
+    "emits a 'regression' obs instant and bumps "
+    "last_metrics['regressionAlerts'].  Active only with "
+    "spark.rapids.sql.tpu.history.dir set.")
+SENTINEL_MIN_RUNS = conf_int(
+    "spark.rapids.sql.tpu.sentinel.minRuns", 3,
+    "Aggregated runs a fingerprint needs before the sentinel compares "
+    "against it — below this the baseline is too thin to call a "
+    "regression (cold caches and first-run compiles would all flag).")
+SENTINEL_MAD_THRESHOLD = conf_float(
+    "spark.rapids.sql.tpu.sentinel.madThreshold", 4.0,
+    "Half-width of the sentinel's acceptance band in robust deviations: "
+    "a guarded key regresses when value > median + threshold * "
+    "max(MAD, 25% of median, key floor).  Larger values tolerate more "
+    "run-to-run noise before alerting.")
 
 
 def registry() -> List[ConfEntry]:

@@ -26,7 +26,7 @@ query that just produced rows.
 from __future__ import annotations
 
 import os
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from spark_rapids_tpu.history import store
 
@@ -114,20 +114,44 @@ def begin_query(session, plan, phys, ctx) -> None:
 
 
 def end_query(session, plan, phys, ctx, metrics: Dict[str, Any],
-              wall_ns: int, out) -> None:
-    """Append this query's record to the store (no-op when inactive; a
-    store IO failure never fails the query)."""
+              wall_ns: int, out) -> List[Dict[str, Any]]:
+    """Append this query's record to the store and run the regression
+    sentinel against the store's aggregate of previous runs.  Returns
+    the sentinel's alert list (empty when inactive, thin baseline, or
+    in band).  The comparison runs BEFORE the append so a regressed run
+    never poisons its own baseline; a store IO failure never fails the
+    query that just produced rows."""
     d = getattr(ctx, "_history_dir", None)
     if d is None:
-        return
+        return []
     from spark_rapids_tpu.history import seeding
     rec = seeding.harvest(phys, metrics, wall_ns,
                           getattr(out, "num_rows", 0),
                           ctx._history_fp, ctx._history_conf_sig)
+    alerts: List[Dict[str, Any]] = []
+    conf = session.conf
+    from spark_rapids_tpu.config import (
+        HISTORY_AGGREGATE_RUNS, HISTORY_STORE_MAX_RECORDS,
+        SENTINEL_ENABLED, SENTINEL_MAD_THRESHOLD, SENTINEL_MIN_RUNS,
+    )
+    if SENTINEL_ENABLED.get(conf):
+        from spark_rapids_tpu.obs import events as obs_events
+        from spark_rapids_tpu.obs import sentinel
+        agg = store.aggregate(
+            d, ctx._history_fp, ctx._history_conf_sig,
+            runs=HISTORY_AGGREGATE_RUNS.get(conf),
+            max_records=HISTORY_STORE_MAX_RECORDS.get(conf))
+        alerts = sentinel.check(rec, agg,
+                                SENTINEL_MAD_THRESHOLD.get(conf),
+                                SENTINEL_MIN_RUNS.get(conf))
+        for alert in alerts:
+            obs_events.emit_instant("history", "regression",
+                                    ctx._history_fp, **alert)
     try:
         store.append(d, rec)
     except OSError:
         pass
+    return alerts
 
 
 def runtime_stats() -> Dict[str, int]:
@@ -135,4 +159,6 @@ def runtime_stats() -> Dict[str, int]:
     out = dict(store.stats())
     from spark_rapids_tpu.history.fragcache import fragment_cache
     out.update(fragment_cache().stats())
+    from spark_rapids_tpu.obs import sentinel
+    out["regression_alerts_total"] = sentinel.alerts_total()
     return out

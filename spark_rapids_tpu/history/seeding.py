@@ -150,8 +150,10 @@ def harvest(phys, metrics: dict, wall_ns: int, out_rows: int,
         "conf_sig": conf_sig,
         "wall_ns": int(wall_ns),
         "out_rows": int(out_rows),
+        "dispatches": m("dispatchCount"),
         "compile_count": m("compileCount"),
         "compile_wall_ns": m("compileWallNs"),
+        "shuffle_bytes": m("shuffleBytes"),
         "spill_host_bytes": m("spillToHostBytes"),
         "spill_disk_bytes": m("spillToDiskBytes"),
         "exchanges": exchanges,

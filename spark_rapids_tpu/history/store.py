@@ -29,23 +29,40 @@ import threading
 import time
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
-#: Schema version stamped into every record.
-STORE_VERSION = 1
+#: Schema version stamped into every record.  v2: records additionally
+#: carry ``dispatches``/``shuffle_bytes`` and the loader folds a robust
+#: per-fingerprint aggregate (median/MAD over recent runs) alongside the
+#: newest-wins record — the regression sentinel's baseline.
+STORE_VERSION = 2
 
 #: File the store lives in, under spark.rapids.sql.tpu.history.dir.
 STORE_FILENAME = "stats.jsonl"
 
+#: Numeric record keys folded into the per-fingerprint aggregate.
+AGGREGATE_KEYS = ("wall_ns", "dispatches", "compile_count",
+                  "shuffle_bytes", "spill_host_bytes", "spill_disk_bytes")
+
+#: Per-fingerprint bound on runs the loader retains for aggregation
+#: (``history.aggregateRuns`` asks for at most this many).
+AGG_MAX_RUNS = 32
+
 #: Conf-key prefixes excluded from the plan-relevant conf signature —
-#: observability and history knobs never change plans or results.
+#: observability, history, sentinel and fault-injection knobs never
+#: change the plan (faults distort a run's RUNTIME, which is exactly
+#: what the regression sentinel must see compared against the same
+#: fingerprint's clean baseline, not forked into a separate one).
 _SIG_EXCLUDE_PREFIXES = (
     "spark.rapids.sql.tpu.metrics.",
     "spark.rapids.sql.tpu.obs.",
     "spark.rapids.sql.tpu.history.",
+    "spark.rapids.sql.tpu.sentinel.",
+    "spark.rapids.sql.tpu.faults.",
 )
 
 _lock = threading.Lock()
-#: dir -> (mtime_ns, size, {fp_hash: record})
-_cache: Dict[str, Tuple[int, int, Dict[str, dict]]] = {}
+#: dir -> (mtime_ns, size, {fp_hash: record}, {fp_hash: [recent runs]})
+_cache: Dict[str, Tuple[int, int, Dict[str, dict],
+                        Dict[str, List[dict]]]] = {}
 _stats = {
     "history_store_queries": 0,
     "history_store_hits": 0,
@@ -63,8 +80,9 @@ def conf_signature(settings: Iterable[Tuple[str, Any]]) -> str:
 
     Seeded decisions recorded under one configuration must not leak
     into sessions planned under another, so records carry this
-    signature and lookups require it to match.  metrics./obs./history.
-    keys are excluded — they never alter plans or results.
+    signature and lookups require it to match.  The
+    ``_SIG_EXCLUDE_PREFIXES`` families are excluded — they never alter
+    the plan.
     """
     items = sorted((k, str(v)) for k, v in settings
                    if not k.startswith(_SIG_EXCLUDE_PREFIXES))
@@ -95,19 +113,29 @@ def _parse_lines(path: str) -> List[dict]:
     return records
 
 
-def _fold(records: List[dict], max_records: int) -> Dict[str, dict]:
-    """Newest record per fingerprint; overall bounded to max_records
-    newest (file order is append order, so later lines are newer)."""
+def _fold(records: List[dict], max_records: int
+          ) -> Tuple[Dict[str, dict], Dict[str, List[dict]]]:
+    """(newest record per fingerprint, recent runs per fingerprint);
+    overall bounded to max_records newest (file order is append order,
+    so later lines are newer); per-fingerprint runs bounded to
+    AGG_MAX_RUNS newest."""
     if max_records and max_records > 0:
         records = records[-max_records:]
     folded: Dict[str, dict] = {}
+    runs: Dict[str, List[dict]] = {}
     for rec in records:  # later lines overwrite earlier ones
-        folded[str(rec["fp"])] = rec
-    return folded
+        fp = str(rec["fp"])
+        folded[fp] = rec
+        lst = runs.setdefault(fp, [])
+        lst.append(rec)
+        if len(lst) > AGG_MAX_RUNS:
+            del lst[0]
+    return folded, runs
 
 
-def load(dir_path: str, max_records: int = 0) -> Dict[str, dict]:
-    """Load (cached) the folded {fp_hash: record} map for a store dir."""
+def _load_all(dir_path: str, max_records: int = 0
+              ) -> Tuple[Dict[str, dict], Dict[str, List[dict]]]:
+    """Load (cached) both fold shapes for a store dir."""
     path = store_path(dir_path)
     try:
         st = os.stat(path)
@@ -115,15 +143,64 @@ def load(dir_path: str, max_records: int = 0) -> Dict[str, dict]:
     except OSError:
         with _lock:
             _cache.pop(dir_path, None)
-        return {}
+        return {}, {}
     with _lock:
         cached = _cache.get(dir_path)
         if cached is not None and (cached[0], cached[1]) == stamp:
-            return cached[2]
-    folded = _fold(_parse_lines(path), max_records)
+            return cached[2], cached[3]
+    folded, runs = _fold(_parse_lines(path), max_records)
     with _lock:
-        _cache[dir_path] = (stamp[0], stamp[1], folded)
-    return folded
+        _cache[dir_path] = (stamp[0], stamp[1], folded, runs)
+    return folded, runs
+
+
+def load(dir_path: str, max_records: int = 0) -> Dict[str, dict]:
+    """Load (cached) the folded {fp_hash: record} map for a store dir."""
+    return _load_all(dir_path, max_records)[0]
+
+
+def runs_for(dir_path: str, fp_hash: str, conf_sig: str = "",
+             max_records: int = 0) -> List[dict]:
+    """The retained recent runs of one fingerprint, oldest first,
+    restricted to ``conf_sig`` when given (a run recorded under a
+    different plan-relevant configuration is a different workload)."""
+    runs = _load_all(dir_path, max_records)[1].get(fp_hash, [])
+    if conf_sig:
+        runs = [r for r in runs if r.get("conf_sig") == conf_sig]
+    return runs
+
+
+def _median(vals: List[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    if not n:
+        return 0.0
+    mid = n // 2
+    return s[mid] if n % 2 else (s[mid - 1] + s[mid]) / 2.0
+
+
+def aggregate_records(recs: List[dict]) -> Dict[str, Any]:
+    """Fold a run list into the sentinel's baseline shape:
+    ``{"n": len(recs), "keys": {key: {"median", "mad"}}}`` for every
+    AGGREGATE_KEYS key."""
+    keys: Dict[str, Dict[str, float]] = {}
+    for key in AGGREGATE_KEYS:
+        vals = [float(r.get(key, 0) or 0) for r in recs]
+        med = _median(vals)
+        mad = _median([abs(v - med) for v in vals])
+        keys[key] = {"median": med, "mad": mad}
+    return {"n": len(recs), "keys": keys}
+
+
+def aggregate(dir_path: str, fp_hash: str, conf_sig: str = "",
+              runs: int = 8, max_records: int = 0) -> Dict[str, Any]:
+    """Robust aggregate over the last ``runs`` retained runs of a
+    fingerprint — the regression sentinel's baseline, also shown by
+    ``rapidshist --json``."""
+    recs = runs_for(dir_path, fp_hash, conf_sig, max_records)
+    if runs and runs > 0:
+        recs = recs[-runs:]
+    return aggregate_records(recs)
 
 
 def lookup(dir_path: str, fp_hash: str, conf_sig: str,
@@ -174,7 +251,7 @@ def prune(dir_path: str, max_records: int) -> Tuple[int, int]:
     path = store_path(dir_path)
     records = _parse_lines(path)
     before = len(records)
-    folded = _fold(records, max_records)
+    folded = _fold(records, max_records)[0]
     # preserve append order among survivors
     keep_ids = {id(rec) for rec in folded.values()}
     survivors = [rec for rec in records if id(rec) in keep_ids]

@@ -43,6 +43,20 @@ def decode_pool_size() -> int:
         return _pool_size if _pool is not None else 0
 
 
+def decode_pool_utilization() -> float:
+    """Queued-work backlog as a fraction of the pool size — the
+    ``io.decode_pool_utilization`` telemetry gauge (0.0 when no pool
+    exists; executors without an inspectable work queue read as idle)."""
+    with _lock:
+        pool, size = _pool, _pool_size
+    if pool is None or size <= 0:
+        return 0.0
+    try:
+        return pool._work_queue.qsize() / float(size)
+    except AttributeError:
+        return 0.0
+
+
 def _shutdown(pool: concurrent.futures.ThreadPoolExecutor,
               timeout: float = 5.0) -> None:
     # shutdown(wait=True) joins without a bound; reap each worker with a

@@ -365,6 +365,7 @@ class BufferCatalog:
         self._queue: Deque[_SpillTask] = deque()
         self._queue_cond = threading.Condition(self._lock)
         self._writers: List[threading.Thread] = []
+        self._writers_busy = 0
         self.metrics = {"spilled_to_host": 0, "spilled_to_disk": 0,
                         "unspilled": 0, "spill_cancelled": 0,
                         "spill_wall_ns": 0, "spill_queue_depth_max": 0,
@@ -420,6 +421,34 @@ class BufferCatalog:
     def host_bytes_in_use(self) -> int:
         with self._lock:
             return self._host_bytes
+
+    # -- telemetry gauges (obs.timeseries; sampled at export time) ----------
+
+    def writer_utilization(self) -> float:
+        """Fraction of the spill-writer pool running a task right now."""
+        with self._lock:
+            return self._writers_busy / float(max(1, self.writer_threads))
+
+    def writer_queue_depth(self) -> int:
+        """Spill tasks queued but not yet picked up by a writer."""
+        with self._lock:
+            return len(self._queue)
+
+    def tier_bytes(self) -> Dict[str, int]:
+        """Bytes resident per tier right now: the device/host running
+        counters plus a disk scan over spilled files (OSError-tolerant —
+        a file mid-delete reads as absent)."""
+        with self._lock:
+            disk = 0
+            for h in self._handles.values():
+                path = h._disk_path
+                if path:
+                    try:
+                        disk += os.path.getsize(path)
+                    except OSError:
+                        continue
+            return {"device": self._device_bytes,
+                    "host": self._host_bytes, "disk": disk}
 
     def verify_accounting(self) -> List[str]:
         """Debug invariant (analysis/plan_verify.py): the incremental
@@ -490,8 +519,13 @@ class BufferCatalog:
                 while not self._queue:
                     self._queue_cond.wait(_WAIT_SLICE)
                 task = self._queue.popleft()
-            with obs_events.adopt(task.scope):
-                self._run_spill_task(task)
+                self._writers_busy += 1
+            try:
+                with obs_events.adopt(task.scope):
+                    self._run_spill_task(task)
+            finally:
+                with self._lock:
+                    self._writers_busy -= 1
 
     def _run_spill_task(self, task: _SpillTask,
                         raise_errors: bool = False) -> None:

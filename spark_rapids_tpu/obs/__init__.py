@@ -16,6 +16,7 @@ from .events import (  # noqa: F401 — re-exported emitter surface
     Event, EventBus, QueryScope, active, adopt, begin_query, current_scope,
     emit_instant, emit_span, end_query,
 )
+from . import critpath, sentinel, timeseries  # noqa: F401 — obs v2 surface
 
 # -- explain sink -------------------------------------------------------------
 #

@@ -50,8 +50,18 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
+from . import timeseries as _ts
+
 SPAN = "span"
 INSTANT = "instant"
+
+#: Process-cumulative events dropped across every query ring — the
+#: telemetry gauge feed (per-query drops surface via obsEventsDropped).
+_RING_DROPS_TOTAL = 0
+
+
+def ring_drops_total() -> int:
+    return _RING_DROPS_TOTAL
 
 
 class Event:
@@ -110,13 +120,27 @@ class EventBus:
         self._lock = threading.Lock()
         self._events: deque = deque()
         self._dropped = 0
+        #: site -> drop count; a truncated profile's rollups silently
+        #: under-attribute exactly these sites, so the summary banner
+        #: must name them
+        self._dropped_by_site: Dict[str, int] = {}
 
     def append(self, ev: Event) -> None:
+        global _RING_DROPS_TOTAL
         with self._lock:
             if len(self._events) >= self._max:
                 self._dropped += 1
+                site = getattr(ev, "site", None) or "?"
+                self._dropped_by_site[site] = \
+                    self._dropped_by_site.get(site, 0) + 1
+                _RING_DROPS_TOTAL += 1
                 return
             self._events.append(ev)
+
+    def drop_sites(self) -> Dict[str, int]:
+        """Per-site drop counts since the last drain."""
+        with self._lock:
+            return dict(self._dropped_by_site)
 
     def drain(self) -> Tuple[List[Event], int]:
         with self._lock:
@@ -124,6 +148,7 @@ class EventBus:
             self._events.clear()
             dropped = self._dropped
             self._dropped = 0
+            self._dropped_by_site = {}
             return evs, dropped
 
     def __len__(self):
@@ -227,13 +252,14 @@ def begin_query(enabled: bool, max_events: int) -> Optional[QueryScope]:
         return scope
 
 
-def end_query(scope: Optional[QueryScope]) -> Tuple[List[Event], int]:
-    """Close ``scope`` and drain its (events, dropped).  A None scope
-    (nested execute) is a no-op returning ([], 0).  Straggler emits
-    after the close (e.g. an async spill writer finishing late) find no
-    scope and vanish."""
+def end_query(scope: Optional[QueryScope]
+              ) -> Tuple[List[Event], int, Dict[str, int]]:
+    """Close ``scope`` and drain its (events, dropped, dropped_by_site).
+    A None scope (nested execute) is a no-op returning ([], 0, {}).
+    Straggler emits after the close (e.g. an async spill writer
+    finishing late) find no scope and vanish."""
     if scope is None:
-        return [], 0
+        return [], 0, {}
     with _EPOCH_LOCK:
         for ident in [i for i, s in _SCOPES.items() if s is scope]:
             del _SCOPES[ident]
@@ -241,8 +267,10 @@ def end_query(scope: Optional[QueryScope]) -> Tuple[List[Event], int]:
             _OPEN.remove(scope)
         _recompute_fallback_locked()
     if scope.bus is None:
-        return [], 0
-    return scope.bus.drain()
+        return [], 0, {}
+    by_site = scope.bus.drop_sites()
+    events, dropped = scope.bus.drain()
+    return events, dropped, by_site
 
 
 class _adopt_ctx:
@@ -289,7 +317,12 @@ def adopt(scope: Optional[QueryScope]) -> "_adopt_ctx":
 
 def emit_span(site: str, name: str, op_id: str = "",
               t0: int = 0, t1: int = 0, **payload) -> None:
-    """Record a timed range.  No-op outside a scope with a live ring."""
+    """Record a timed range.  No-op outside a scope with a live ring
+    (the continuous telemetry fold still runs — it is process-scoped,
+    not query-scoped, so late async-writer spans and inter-query work
+    stay visible in the time-series view)."""
+    if _ts._RING is not None:
+        _ts.record_span(site, t1 - t0, int(payload.get("bytes", 0) or 0))
     sc = _SCOPES.get(threading.get_ident()) or _FALLBACK
     if sc is None or sc.bus is None:
         return
@@ -299,7 +332,9 @@ def emit_span(site: str, name: str, op_id: str = "",
 
 def emit_instant(site: str, name: str, op_id: str = "", **payload) -> None:
     """Record a point event stamped now.  No-op outside a scope with a
-    live ring."""
+    live ring (the telemetry fold counts it regardless, like spans)."""
+    if _ts._RING is not None:
+        _ts.record_span(site, 0, int(payload.get("bytes", 0) or 0))
     sc = _SCOPES.get(threading.get_ident()) or _FALLBACK
     if sc is None or sc.bus is None:
         return

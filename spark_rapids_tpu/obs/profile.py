@@ -43,13 +43,19 @@ class QueryProfile:
     def __init__(self, query_id: int, events: List, dropped: int = 0,
                  wall_ns: int = 0,
                  metrics: Optional[Dict[str, Any]] = None,
-                 op_metrics: Optional[Dict[str, Dict[str, Any]]] = None):
+                 op_metrics: Optional[Dict[str, Dict[str, Any]]] = None,
+                 dropped_by_site: Optional[Dict[str, int]] = None,
+                 session_id: int = 0, qt0_ns: int = 0, qt1_ns: int = 0):
         self.query_id = query_id
         self.events = list(events)
         self.dropped = int(dropped)
         self.wall_ns = int(wall_ns)
         self.metrics = dict(metrics or {})
         self.op_metrics = dict(op_metrics or {})
+        self.dropped_by_site = dict(dropped_by_site or {})
+        self.session_id = int(session_id)
+        self.qt0_ns = int(qt0_ns)
+        self.qt1_ns = int(qt1_ns)
         self.op_rollups: Dict[str, Dict[str, Any]] = {}
         self.site_totals: Dict[str, Dict[str, int]] = {}
         self.t_min = 0
@@ -130,6 +136,9 @@ class QueryProfile:
         return {
             "type": "query", "id": self.query_id, "wall_ns": self.wall_ns,
             "event_count": self.event_count, "dropped": self.dropped,
+            "dropped_by_site": self.dropped_by_site,
+            "session": self.session_id,
+            "t0_ns": self.qt0_ns, "t1_ns": self.qt1_ns,
             "metrics": self.metrics,
         }
 
@@ -144,6 +153,15 @@ class QueryProfile:
             f"device {attr / 1e6:.2f} ms attributed ({pct:.0f}% of "
             f"deviceTimeNs)"
         ]
+        if self.dropped:
+            sites = ", ".join(
+                f"{s}={n}" for s, n in sorted(self.dropped_by_site.items(),
+                                              key=lambda kv: -kv[1])) \
+                or "unknown sites"
+            lines.append(
+                f"  !! TRUNCATED: {self.dropped} events dropped at the "
+                f"ring ({sites}) — per-site totals undercount; raise "
+                f"spark.rapids.sql.tpu.obs.ring.maxEvents")
         for r in self.top_operators(5):
             lines.append(
                 f"  {r['name'] or r['op_id'] or '?'}: "

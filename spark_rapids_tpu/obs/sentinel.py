@@ -1,0 +1,90 @@
+"""Cross-run regression sentinel.
+
+The history store (history/store.py) now folds a robust aggregate —
+median and MAD over the last ``history.aggregateRuns`` runs — per plan
+fingerprint.  This module is the comparison half: given a fresh query's
+harvest record and that aggregate, flag every guarded key whose value
+sits above its acceptance band
+
+    value > median + madThreshold * max(MAD, 25% * median, key floor)
+
+The MAD floor matters: N identical clean runs give MAD == 0, and a
+hair-trigger band would flag ordinary scheduler jitter.  The relative
+floor (25% of median) plus a per-key absolute floor keeps the band wide
+enough that only real regressions — an injected ``dispatch:slow``, a
+lost cache, a plan change — clear it.  Only upward excursions alert:
+getting faster is not a regression.
+
+Engine-free (stdlib only) like the rest of ``obs/``; the session glue
+lives in ``history.end_query`` (compare BEFORE appending the fresh run,
+so a regressed run never poisons its own baseline), which emits one
+``regression`` obs instant per alert and sets
+``last_metrics['regressionAlerts']``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List
+
+#: Harvest-record keys the sentinel guards, with per-key absolute band
+#: floors (units match the record: ns for wall, counts, bytes).
+GUARDED_KEYS: Dict[str, float] = {
+    "wall_ns": 2e6,          # 2 ms: sub-noise walls never alert
+    "dispatches": 2.0,
+    "compile_count": 1.0,
+    "shuffle_bytes": 1 << 16,
+    "spill_host_bytes": 1 << 16,
+    "spill_disk_bytes": 1 << 16,
+}
+
+#: Relative floor on the band half-width, as a fraction of the median.
+REL_FLOOR = 0.25
+
+_lock = threading.Lock()
+_alerts_total = 0
+
+
+def check(record: Dict[str, Any], aggregate: Dict[str, Any],
+          threshold: float, min_runs: int) -> List[Dict[str, Any]]:
+    """Compare a fresh harvest ``record`` against a store ``aggregate``
+    (``history.store.aggregate`` shape: ``{"n": int, "keys": {key:
+    {"median", "mad"}}}``).  Returns one alert dict per regressed key —
+    empty when the baseline is too thin (< ``min_runs``) or everything
+    is in band."""
+    n = int(aggregate.get("n", 0) or 0)
+    if n < max(1, int(min_runs)):
+        return []
+    alerts: List[Dict[str, Any]] = []
+    for key, st in (aggregate.get("keys") or {}).items():
+        floor = GUARDED_KEYS.get(key)
+        if floor is None:
+            continue
+        med = float(st.get("median", 0.0) or 0.0)
+        mad = float(st.get("mad", 0.0) or 0.0)
+        value = float(record.get(key, 0) or 0)
+        band = med + float(threshold) * max(mad, REL_FLOOR * abs(med),
+                                            floor)
+        if value > band:
+            alerts.append({
+                "key": key, "value": value, "median": med, "mad": mad,
+                "band": band, "runs": n,
+            })
+    if alerts:
+        global _alerts_total
+        with _lock:
+            _alerts_total += len(alerts)
+    return alerts
+
+
+def alerts_total() -> int:
+    """Process-cumulative alert count (the serve ``stats()`` rollup
+    key ``regression_alerts_total``)."""
+    with _lock:
+        return _alerts_total
+
+
+def reset_alerts_total() -> None:
+    global _alerts_total
+    with _lock:
+        _alerts_total = 0

@@ -1,0 +1,374 @@
+"""Continuous time-series telemetry: the process-wide aggregation ring.
+
+The per-query event bus (obs.events) answers "where did THIS query's
+time go"; nothing before this module answered "what is the process doing
+right now, over time" — the role Spark's metrics sinks + Prometheus
+servlet play for the reference accelerator.  Every span the obs
+chokepoints emit also folds here into a fixed-interval aggregation ring:
+
+* one :class:`Interval` per ``obs.telemetry.intervalMs`` wall-clock
+  bucket, holding per-site ``[count, wall_ns, bytes]`` rollups plus
+  bounded per-interval value samples (the serve scheduler feeds query
+  latencies for its sliding-window percentiles);
+* a bounded deque of completed intervals — drop-OLDEST past
+  ``obs.telemetry.maxIntervals`` (the live view must keep the newest
+  data; the per-query ring keeps the oldest for the opposite reason);
+* gauges (catalog tier bytes, spill-writer/decode-pool utilization,
+  serve queue depth, fragment-cache occupancy, obs ring drops) are
+  registered as callables and sampled at export time — never inside the
+  emit path, so a gauge that takes the catalog lock can never deadlock
+  against a spill span emitted under it.
+
+Exports: JSONL flushes (``telemetry-<pid>.jsonl`` beside the event log,
+the ``tools/rapidstop.py`` input) and Prometheus-style exposition text.
+Engine-free (stdlib only) like the rest of ``obs/`` so rapidstop loads
+the package standalone; the fold path is one lock-protected dict update
+and the disabled path is a single ``is None`` test in obs.events.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+#: Per-interval cap on stored value samples per series (bounds memory
+#: when a burst lands thousands of serve completions in one interval).
+MAX_VALUES_PER_INTERVAL = 512
+
+#: Prometheus metric-name prefix for every exported series.
+PROM_PREFIX = "rapids"
+
+
+class Interval:
+    """One closed aggregation window: ``sites`` maps site ->
+    ``[count, wall_ns, bytes]``; ``values`` maps series name -> bounded
+    sample list; ``gauges`` is attached at export time."""
+
+    __slots__ = ("idx", "t0_ns", "dur_ns", "sites", "values", "gauges")
+
+    def __init__(self, idx: int, t0_ns: int, dur_ns: int):
+        self.idx = idx
+        self.t0_ns = t0_ns
+        self.dur_ns = dur_ns
+        self.sites: Dict[str, List[int]] = {}
+        self.values: Dict[str, List[float]] = {}
+        self.gauges: Dict[str, float] = {}
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "type": "interval", "idx": self.idx, "t0_ns": self.t0_ns,
+            "dur_ns": self.dur_ns, "sites": self.sites,
+        }
+        if self.values:
+            d["values"] = self.values
+        if self.gauges:
+            d["gauges"] = self.gauges
+        return d
+
+
+class TelemetryRing:
+    """The aggregation ring.  ``record_span`` is the hot path: one lock,
+    one bucket-index division, one dict update.  Interval rotation
+    happens lazily when a fold lands in a newer bucket (an idle process
+    rotates at the next export instead — see :meth:`roll_now`)."""
+
+    def __init__(self, interval_ms: int, max_intervals: int):
+        self.interval_ns = max(1, int(interval_ms)) * 1_000_000
+        self.max_intervals = max(1, int(max_intervals))
+        self._lock = threading.Lock()
+        self._cur: Optional[Interval] = None
+        self._done: deque = deque(maxlen=self.max_intervals)
+        self._gauges: Dict[str, Callable[[], float]] = {}
+        self.completed_total = 0
+        self.dropped_intervals = 0
+        self._flush_offset = 0  # completed_total already flushed to JSONL
+
+    # -- fold (hot path) ----------------------------------------------------
+
+    def record_span(self, site: str, wall_ns: int, nbytes: int = 0) -> None:
+        now = time.monotonic_ns()
+        with self._lock:
+            cur = self._rotate_locked(now)
+            st = cur.sites.get(site)
+            if st is None:
+                st = cur.sites[site] = [0, 0, 0]
+            st[0] += 1
+            st[1] += max(0, int(wall_ns))
+            st[2] += int(nbytes or 0)
+
+    def record_value(self, name: str, value: float) -> None:
+        """Append one sample to the current interval's ``name`` series
+        (bounded per interval) — the sliding-window feed."""
+        now = time.monotonic_ns()
+        with self._lock:
+            cur = self._rotate_locked(now)
+            vals = cur.values.get(name)
+            if vals is None:
+                vals = cur.values[name] = []
+            if len(vals) < MAX_VALUES_PER_INTERVAL:
+                vals.append(float(value))
+
+    def _rotate_locked(self, now_ns: int) -> Interval:
+        idx = now_ns // self.interval_ns
+        cur = self._cur
+        if cur is not None and cur.idx == idx:
+            return cur
+        if cur is not None and (cur.sites or cur.values):
+            # empty intervals (an idle process, or the fresh bucket an
+            # export's roll_now opened) never complete: they would pad
+            # the ring and the JSONL with zero rows
+            if len(self._done) == self._done.maxlen:
+                self.dropped_intervals += 1
+            self._done.append(cur)
+            self.completed_total += 1
+        cur = self._cur = Interval(idx, idx * self.interval_ns,
+                                   self.interval_ns)
+        return cur
+
+    # -- gauges -------------------------------------------------------------
+
+    def register_gauge(self, name: str, fn: Callable[[], float]) -> None:
+        """Register (or replace) a gauge sampled at export time.  The
+        callable runs OUTSIDE the ring lock and may take engine locks."""
+        with self._lock:
+            self._gauges[name] = fn
+
+    def sample_gauges(self) -> Dict[str, float]:
+        with self._lock:
+            fns = list(self._gauges.items())
+        out: Dict[str, float] = {}
+        for name, fn in fns:
+            try:
+                out[name] = float(fn())
+            except Exception:
+                # a gauge over a torn-down subsystem (closed catalog,
+                # stopped scheduler) must never break telemetry export
+                continue
+        out["telemetry.dropped_intervals"] = float(self.dropped_intervals)
+        return out
+
+    # -- read side ----------------------------------------------------------
+
+    def roll_now(self) -> None:
+        """Force-close the current interval if its window has passed —
+        export paths call this so an idle tail interval still lands."""
+        now = time.monotonic_ns()
+        with self._lock:
+            cur = self._cur
+            if cur is not None and now // self.interval_ns != cur.idx:
+                self._rotate_locked(now)
+
+    def snapshot(self) -> List[Interval]:
+        """Completed intervals, oldest first (current interval excluded:
+        it is still accumulating)."""
+        self.roll_now()
+        with self._lock:
+            return list(self._done)
+
+    def window_values(self, name: str) -> List[float]:
+        """Every stored sample of ``name`` across the ring window
+        (completed intervals + the open one), oldest first."""
+        with self._lock:
+            out: List[float] = []
+            for iv in self._done:
+                out.extend(iv.values.get(name, ()))
+            if self._cur is not None:
+                out.extend(self._cur.values.get(name, ()))
+            return out
+
+    def window_seconds(self) -> float:
+        """Wall seconds the ring can span when full."""
+        return self.max_intervals * self.interval_ns / 1e9
+
+    # -- export -------------------------------------------------------------
+
+    def flush_jsonl(self, path: str) -> int:
+        """Append intervals completed since the last flush to ``path``
+        (gauges sampled once per flush, attached to the newest flushed
+        interval).  Returns how many intervals were written."""
+        self.roll_now()
+        with self._lock:
+            done = list(self._done)
+            total = self.completed_total
+            start = len(done) - (total - self._flush_offset)
+            fresh = done[max(0, start):]
+            self._flush_offset = total
+        if not fresh:
+            return 0
+        fresh[-1].gauges = self.sample_gauges()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "a", encoding="utf-8") as f:
+            for iv in fresh:
+                f.write(json.dumps(iv.to_dict()) + "\n")
+        return len(fresh)
+
+    def prometheus_text(self) -> str:
+        """Prometheus exposition-format text: per-site counters summed
+        over the ring window plus the current gauge samples."""
+        totals: Dict[str, List[int]] = {}
+        for iv in self.snapshot():
+            for site, st in iv.sites.items():
+                t = totals.setdefault(site, [0, 0, 0])
+                t[0] += st[0]
+                t[1] += st[1]
+                t[2] += st[2]
+        return render_prometheus(totals, self.sample_gauges(),
+                                 self.completed_total)
+
+
+# -- shared renderers (live ring + rapidstop's offline JSONL) -----------------
+
+def _prom_name(raw: str) -> str:
+    return "".join(c if c.isalnum() or c == "_" else "_" for c in raw)
+
+
+def render_prometheus(site_totals: Dict[str, List[int]],
+                      gauges: Dict[str, float],
+                      intervals_total: int) -> str:
+    """Render site ``[count, wall_ns, bytes]`` totals + gauges as
+    Prometheus exposition text (shared by the live ring and rapidstop's
+    offline ``--prom`` over a flushed JSONL)."""
+    lines = [
+        f"# TYPE {PROM_PREFIX}_telemetry_intervals_total counter",
+        f"{PROM_PREFIX}_telemetry_intervals_total {intervals_total}",
+    ]
+    for suffix, pos in (("events_total", 0), ("wall_ns_total", 1),
+                        ("bytes_total", 2)):
+        lines.append(f"# TYPE {PROM_PREFIX}_site_{suffix} counter")
+        for site in sorted(site_totals):
+            lines.append(
+                f'{PROM_PREFIX}_site_{suffix}{{site="{_prom_name(site)}"}} '
+                f"{site_totals[site][pos]}")
+    for name in sorted(gauges):
+        metric = f"{PROM_PREFIX}_{_prom_name(name)}"
+        lines.append(f"# TYPE {metric} gauge")
+        lines.append(f"{metric} {gauges[name]:g}")
+    return "\n".join(lines) + "\n"
+
+
+def read_telemetry_log(path: str) -> List[Dict[str, Any]]:
+    """Parse a flushed telemetry JSONL back into interval dicts, oldest
+    first (rapidstop's input; torn tail lines are skipped)."""
+    out: List[Dict[str, Any]] = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and rec.get("type") == "interval":
+                out.append(rec)
+    return out
+
+
+def render_intervals(intervals: List[Dict[str, Any]], last: int = 0) -> str:
+    """The rapidstop "top" view: newest interval's per-site table plus a
+    window rollup over ``last`` (0 = all) intervals."""
+    if not intervals:
+        return "(no telemetry intervals)"
+    if last and last > 0:
+        intervals = intervals[-last:]
+    newest = intervals[-1]
+    lines = [
+        f"telemetry: {len(intervals)} interval(s), "
+        f"{int(newest.get('dur_ns', 0)) / 1e6:.0f} ms each, newest idx "
+        f"{newest.get('idx')}",
+        "",
+        "  site      |   events |   wall ms |       MB |    GB/s",
+    ]
+
+    def row(site: str, st: List[int]) -> str:
+        count, wall, nbytes = int(st[0]), int(st[1]), int(st[2])
+        gbps = f"{nbytes / wall:.3f}" if wall else "-"
+        return (f"  {site:<9} | {count:>8} | {wall / 1e6:>9.2f} | "
+                f"{nbytes / (1 << 20):>8.2f} | {gbps:>7}")
+
+    newest_sites = newest.get("sites") or {}
+    for site in sorted(newest_sites,
+                       key=lambda s: -int(newest_sites[s][1])):
+        lines.append(row(site, newest_sites[site]))
+    if not newest_sites:
+        lines.append("  (idle interval)")
+    gauges = newest.get("gauges") or {}
+    if gauges:
+        lines.append("")
+        lines.append("  gauges: " + ", ".join(
+            f"{k}={v:g}" for k, v in sorted(gauges.items())))
+    if len(intervals) > 1:
+        totals: Dict[str, List[int]] = {}
+        for iv in intervals:
+            for site, st in (iv.get("sites") or {}).items():
+                t = totals.setdefault(site, [0, 0, 0])
+                t[0] += int(st[0])
+                t[1] += int(st[1])
+                t[2] += int(st[2])
+        lines.append("")
+        lines.append(f"  window ({len(intervals)} intervals):")
+        for site in sorted(totals, key=lambda s: -totals[s][1]):
+            lines.append(row(site, totals[site]))
+    return "\n".join(lines)
+
+
+# -- module singleton ---------------------------------------------------------
+
+#: The process ring, None while disabled.  obs.events reads this global
+#: directly (one ``is None`` branch) on every emit.
+_RING: Optional[TelemetryRing] = None
+_CONFIG_LOCK = threading.Lock()
+
+
+def configure(enabled: bool, interval_ms: int, max_intervals: int) -> None:
+    """(Re)configure the process ring from a session's conf: enable,
+    disable, or keep the live ring when the shape is unchanged (so a
+    repeat execute never resets accumulated intervals)."""
+    global _RING
+    with _CONFIG_LOCK:
+        if not enabled:
+            _RING = None
+            return
+        ring = _RING
+        want_ns = max(1, int(interval_ms)) * 1_000_000
+        if ring is not None and ring.interval_ns == want_ns and \
+                ring.max_intervals == max(1, int(max_intervals)):
+            return
+        _RING = TelemetryRing(interval_ms, max_intervals)
+
+
+def ring() -> Optional[TelemetryRing]:
+    return _RING
+
+
+def record_span(site: str, wall_ns: int, nbytes: int = 0) -> None:
+    """Module-level fold (obs.events emit hook): no-op when disabled."""
+    r = _RING
+    if r is None:
+        return
+    r.record_span(site, wall_ns, nbytes)
+
+
+def record_value(name: str, value: float) -> None:
+    r = _RING
+    if r is None:
+        return
+    r.record_value(name, value)
+
+
+def register_gauge(name: str, fn: Callable[[], float]) -> None:
+    r = _RING
+    if r is None:
+        return
+    r.register_gauge(name, fn)
+
+
+def completed_total() -> int:
+    r = _RING
+    return r.completed_total if r is not None else 0

@@ -152,6 +152,11 @@ class _Tenant:
         lat_ms = (time.monotonic_ns() - item.submit_ns) / 1e6
         if len(self.latencies_ms) < 100000:
             self.latencies_ms.append(lat_ms)
+        # feed the telemetry ring so stats() can report SLIDING-window
+        # percentiles (the all-time lists above never forget a cold start)
+        from spark_rapids_tpu.obs import timeseries as obs_ts
+        obs_ts.record_value("serve.latency_ms", lat_ms)
+        obs_ts.record_value(f"serve.latency_ms.{self.name}", lat_ms)
         if deadline:
             self.deadline_exceeded += 1
             self.failed += 1
@@ -216,6 +221,14 @@ class ServeScheduler:
                 for i in range(self._concurrency)]
         for t in self._runners:
             t.start()
+        # queued + in-flight queries, sampled at telemetry export time
+        from spark_rapids_tpu.obs import timeseries as obs_ts
+        obs_ts.register_gauge("serve.queue_depth", self._queue_depth)
+
+    def _queue_depth(self) -> float:
+        with self._lock:
+            return float(self._inflight + sum(
+                len(t.queue) for t in self._tenants.values()))
 
     # -- submission ---------------------------------------------------------
 
@@ -466,13 +479,30 @@ class ServeScheduler:
         return False
 
     def stats(self) -> Dict[str, Any]:
-        """Aggregate + per-tenant SLO rollup (the bench/CI surface)."""
+        """Aggregate + per-tenant SLO rollup (the bench/CI surface).
+
+        ``p50_ms``/``p99_ms`` stay all-time (every completion since
+        start); the ``window_*`` fields cover only the telemetry ring's
+        current window, so a long-running server's percentiles track
+        what latency looks like NOW rather than averaging in its cold
+        start.  Window fields are 0.0 while telemetry is disabled."""
+        from spark_rapids_tpu.obs import timeseries as obs_ts
         from spark_rapids_tpu.serve.excache import shared_plan_cache
+        ring = obs_ts.ring()
+
+        def window(series: str) -> Tuple[float, float]:
+            if ring is None:
+                return 0.0, 0.0
+            vals = sorted(ring.window_values(series))
+            return _percentile(vals, 0.50), _percentile(vals, 0.99)
+
         with self._lock:
             all_lat = sorted(
                 v for t in self._tenants.values() for v in t.latencies_ms)
-            tenants = {
-                t.name: {
+            tenants = {}
+            for t in self._tenants.values():
+                w50, w99 = window(f"serve.latency_ms.{t.name}")
+                tenants[t.name] = {
                     "weight": t.weight,
                     "submitted": t.submitted,
                     "completed": t.completed,
@@ -480,7 +510,10 @@ class ServeScheduler:
                     "deadline_exceeded": t.deadline_exceeded,
                     "p50_ms": _percentile(sorted(t.latencies_ms), 0.50),
                     "p99_ms": _percentile(sorted(t.latencies_ms), 0.99),
-                } for t in self._tenants.values()}
+                    "window_p50_ms": w50,
+                    "window_p99_ms": w99,
+                }
+            w50, w99 = window("serve.latency_ms")
             out = {
                 "completed": sum(t.completed
                                  for t in self._tenants.values()),
@@ -489,6 +522,9 @@ class ServeScheduler:
                     t.deadline_exceeded for t in self._tenants.values()),
                 "p50_ms": _percentile(all_lat, 0.50),
                 "p99_ms": _percentile(all_lat, 0.99),
+                "window_p50_ms": w50,
+                "window_p99_ms": w99,
+                "window_seconds": ring.window_seconds() if ring else 0.0,
                 "batched_queries": self._batcher.batched_queries,
                 "micro_dispatches": self._batcher.dispatches,
                 "tenants": tenants,

@@ -34,6 +34,20 @@ class _MetricsFrame:
         self.last_metrics: Dict[str, Any] = op_metrics
 
 
+# process-wide session numbering: event-log headers stamp it so
+# rapidsprof can group one shared log's queries by the session that ran
+# them (query ids are already process-globally unique)
+_SESSION_SEQ_LOCK = threading.Lock()
+_SESSION_SEQ = 0
+
+
+def _next_session_id() -> int:
+    global _SESSION_SEQ
+    with _SESSION_SEQ_LOCK:
+        _SESSION_SEQ += 1
+        return _SESSION_SEQ
+
+
 class TpuSparkSession:
     _lock = threading.Lock()
     _active: Optional["TpuSparkSession"] = None
@@ -41,6 +55,7 @@ class TpuSparkSession:
     def __init__(self, conf: Optional[RapidsConf] = None,
                  use_device: bool = True):
         self.conf = conf or global_conf.copy()
+        self.session_id = _next_session_id()
         from spark_rapids_tpu.config import COMPILE_CACHE_DIR
         cache_dir = COMPILE_CACHE_DIR.get(self.conf)
         if cache_dir:
@@ -194,12 +209,22 @@ class TpuSparkSession:
         scheduler uses it for per-tenant rollups."""
         from spark_rapids_tpu.config import (
             FAULTS_SPEC, OBS_ENABLED, OBS_RING_MAX_EVENTS,
+            OBS_TELEMETRY_ENABLED, OBS_TELEMETRY_INTERVAL_MS,
+            OBS_TELEMETRY_MAX_INTERVALS,
         )
         from spark_rapids_tpu.fault import inject as fault_inject
         from spark_rapids_tpu.fault import metrics as FM
         from spark_rapids_tpu.obs import events as obs_events
+        from spark_rapids_tpu.obs import timeseries as obs_ts
         from spark_rapids_tpu.plan.physical import ExecContext, collect_host
         from spark_rapids_tpu.utils import compile_registry as CR
+        # (re)shape the process telemetry ring from this session's conf
+        # and (re)register the engine gauges — a repeat execute with the
+        # same shape keeps the live ring and its accumulated intervals
+        obs_ts.configure(OBS_TELEMETRY_ENABLED.get(self.conf),
+                         OBS_TELEMETRY_INTERVAL_MS.get(self.conf),
+                         OBS_TELEMETRY_MAX_INTERVALS.get(self.conf))
+        self._register_telemetry_gauges()
         phys = self.plan_physical(plan)
         if self.conf.test_enforce_tpu:
             _assert_on_tpu(phys)
@@ -256,6 +281,10 @@ class TpuSparkSession:
         finally:
             if spec:
                 fault_inject.uninstall()
+        # ONE query-end stamp: the wall metric, the history record and
+        # the critical-path window must agree to the nanosecond or the
+        # decomposition's exactness contract breaks
+        t_query1 = time.monotonic_ns()
         if obs_token is not None:
             # per-scope counters: exactly this query's activity, even
             # with N queries in flight (the global snapshot delta would
@@ -406,30 +435,78 @@ class TpuSparkSession:
             "spill_to_disk_bytes")
         if self.runtime is not None:
             frame.last_metrics["memory"] = dict(self.runtime.catalog.metrics)
+        # telemetry economics: how many aggregation intervals the
+        # process ring has completed so far (monotone across queries)
+        frame.last_metrics["telemetryIntervals"] = obs_ts.completed_total()
+        # persist this query's runtime facts for future plan seeding and
+        # run the regression sentinel against the store's aggregate of
+        # previous runs (history/; no-op without a history dir).  This
+        # runs BEFORE the obs drain so each alert's ``regression``
+        # instant lands inside this query's event window
+        alerts = qhistory.end_query(self, plan, phys, ctx,
+                                    frame.last_metrics,
+                                    t_query1 - t_query0, out)
+        frame.last_metrics["regressionAlerts"] = len(alerts)
         # drain the obs epoch and fold it into a bounded-history profile
         # (obs.profile); the event counts become metrics so tests and
         # bench can assert the bus's own economics
-        obs_events_list, obs_dropped = obs_events.end_query(obs_token)
+        obs_events_list, obs_dropped, obs_dropped_by_site = \
+            obs_events.end_query(obs_token)
         frame.last_metrics["obsEventCount"] = len(obs_events_list)
         frame.last_metrics["obsEventsDropped"] = obs_dropped
+        # exact wall decomposition (obs.critpath): the segments partition
+        # [t_query0, t_query1) so attributed + wait == wall EXACTLY
+        from spark_rapids_tpu.obs import critpath as obs_critpath
+        cp = obs_critpath.compute(obs_events_list, t_query0, t_query1)
+        frame.last_metrics["critpathAttributedNs"] = cp.attributed_ns
         # publish by one reference assignment: a concurrent reader of
         # self.last_metrics sees the previous complete dict or this one,
         # never a half-filled frame
         self.last_metrics = frame.last_metrics
-        # persist this query's runtime facts for future plan seeding
-        # (history.store; no-op without a history dir, independent of
-        # the obs bus so a history-only session still learns)
-        qhistory.end_query(self, plan, phys, ctx, frame.last_metrics,
-                           time.monotonic_ns() - t_query0, out)
         if obs_token is not None and obs_token.bus is not None:
             self._record_profile(obs_token.query_id, obs_events_list,
-                                 obs_dropped,
-                                 time.monotonic_ns() - t_query0,
-                                 frame.last_metrics)
+                                 obs_dropped, t_query1 - t_query0,
+                                 frame.last_metrics,
+                                 dropped_by_site=obs_dropped_by_site,
+                                 qt0_ns=t_query0, qt1_ns=t_query1)
         return out, frame.last_metrics
 
+    def _register_telemetry_gauges(self) -> None:
+        """(Re)register the engine gauges on the telemetry ring.  Gauges
+        are sampled at export time only (never inside the emit path), so
+        taking engine locks here is safe."""
+        from spark_rapids_tpu.obs import events as obs_events
+        from spark_rapids_tpu.obs import timeseries as obs_ts
+        if obs_ts.ring() is None:
+            return
+        obs_ts.register_gauge(
+            "obs.ring_drops", lambda: float(obs_events.ring_drops_total()))
+        from spark_rapids_tpu.history.fragcache import fragment_cache
+        obs_ts.register_gauge(
+            "fragcache.bytes",
+            lambda: float(fragment_cache().stats().get(
+                "fragment_cache_bytes", 0)))
+        from spark_rapids_tpu.io.decode_pool import decode_pool_utilization
+        obs_ts.register_gauge("io.decode_pool_utilization",
+                              decode_pool_utilization)
+        rt = self.runtime
+        if rt is None:
+            return
+        cat = rt.catalog
+        for tier in ("device", "host", "disk"):
+            obs_ts.register_gauge(
+                f"catalog.{tier}_bytes",
+                lambda t=tier: float(cat.tier_bytes()[t]))
+        obs_ts.register_gauge("spill.writer_utilization",
+                              cat.writer_utilization)
+        obs_ts.register_gauge(
+            "spill.writer_queue_depth",
+            lambda: float(cat.writer_queue_depth()))
+
     def _record_profile(self, query_id: int, events, dropped: int,
-                        wall_ns: int, metrics: Dict[str, Any]) -> None:
+                        wall_ns: int, metrics: Dict[str, Any],
+                        dropped_by_site: Optional[Dict[str, int]] = None,
+                        qt0_ns: int = 0, qt1_ns: int = 0) -> None:
         """Fold one query's drained events into the bounded history and
         append to the JSONL event log when configured."""
         from spark_rapids_tpu.config import (
@@ -441,7 +518,10 @@ class TpuSparkSession:
         op_metrics = {k: v for k, v in metrics.items()
                       if isinstance(v, dict) and k != "memory"}
         prof = QueryProfile(query_id, events, dropped, wall_ns=wall_ns,
-                            metrics=scalars, op_metrics=op_metrics)
+                            metrics=scalars, op_metrics=op_metrics,
+                            dropped_by_site=dropped_by_site,
+                            session_id=self.session_id,
+                            qt0_ns=qt0_ns, qt1_ns=qt1_ns)
         keep = max(1, OBS_HISTORY_MAX.get(self.conf))
         with self._history_lock:
             self._query_history.append(prof)
@@ -452,6 +532,14 @@ class TpuSparkSession:
             from spark_rapids_tpu.obs import export as obs_export
             path = os.path.join(log_dir, f"events-{os.getpid()}.jsonl")
             obs_export.write_event_log(path, prof.query_record(), events)
+            from spark_rapids_tpu.obs import timeseries as obs_ts
+            r = obs_ts.ring()
+            if r is not None:
+                try:
+                    r.flush_jsonl(os.path.join(
+                        log_dir, f"telemetry-{os.getpid()}.jsonl"))
+                except OSError:
+                    pass
 
     def query_history(self) -> List[Any]:
         """The last ``spark.rapids.sql.tpu.obs.history.maxQueries``

@@ -152,7 +152,10 @@ def test_jsonl_roundtrip_through_rapidsprof(tmp_path):
     log_dir = str(tmp_path / "obslog")
     s = tpu_session(**{"spark.rapids.sql.tpu.obs.eventLogDir": log_dir})
     _simple_query(s).collect()
-    logs = [os.path.join(log_dir, f) for f in os.listdir(log_dir)]
+    # the dir holds the per-pid event log plus the telemetry flush
+    # (telemetry-<pid>.jsonl, rapidstop's input — covered in test_obs_v2)
+    logs = [os.path.join(log_dir, f) for f in os.listdir(log_dir)
+            if f.startswith("events-")]
     assert len(logs) == 1
 
     # the log parses back into the same profile shape

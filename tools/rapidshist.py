@@ -3,14 +3,17 @@
 
 Usage:
     python tools/rapidshist.py <history-dir> [--fingerprint FP]
-        [--prune N] [--json]
+        [--prune N] [--json] [--regressions]
 
 Reads the JSONL statistics store a session wrote under
 ``spark.rapids.sql.tpu.history.dir`` (history/store.py schema) and
 prints, per plan fingerprint: record age, query wall, compile economics,
-spill pressure, and the per-exchange partition layout that seeds the
-next run's plan.  ``--prune N`` rewrites the store keeping the newest
-record per fingerprint, bounded to the N newest overall.
+spill pressure, the median/MAD aggregate over retained runs, and the
+per-exchange partition layout that seeds the next run's plan.
+``--prune N`` rewrites the store keeping the newest record per
+fingerprint, bounded to the N newest overall.  ``--regressions`` runs
+the sentinel offline: each fingerprint's newest run is compared against
+the aggregate of the runs before it, exit code 1 when anything alerts.
 
 Runtime-free by construction (the same loading discipline as
 ``rapidslint``/``rapidsprof``): ``history/store.py`` is stdlib-only and
@@ -46,6 +49,19 @@ def _load_store():
 store = _load_store()
 
 
+def _load_sentinel():
+    """Load spark_rapids_tpu.obs.sentinel standalone (stdlib-only, no
+    relative imports) for the offline ``--regressions`` check."""
+    path = os.path.join(REPO_ROOT, "spark_rapids_tpu", "obs",
+                        "sentinel.py")
+    spec = importlib.util.spec_from_file_location(
+        "rapidshist_sentinel", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["rapidshist_sentinel"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def _age(ts: float) -> str:
     d = max(0.0, time.time() - ts)
     if d < 120:
@@ -61,7 +77,7 @@ def _mb(n: int) -> str:
     return f"{n / (1 << 20):.2f} MB"
 
 
-def describe(rec: dict) -> str:
+def describe(rec: dict, agg: dict = None) -> str:
     lines = [
         f"fingerprint {rec.get('fp')}  (conf {rec.get('conf_sig')}, "
         f"age {_age(float(rec.get('ts', 0) or 0))})",
@@ -70,6 +86,12 @@ def describe(rec: dict) -> str:
         f"compiles {rec.get('compile_count', 0)} "
         f"({float(rec.get('compile_wall_ns', 0)) / 1e6:.1f} ms)",
     ]
+    if agg and int(agg.get("n", 0) or 0) > 1:
+        w = (agg.get("keys") or {}).get("wall_ns") or {}
+        lines.append(
+            f"  aggregate over {agg['n']} run(s): wall median "
+            f"{float(w.get('median', 0)) / 1e6:.2f} ms "
+            f"(MAD {float(w.get('mad', 0)) / 1e6:.2f} ms)")
     sp_h = int(rec.get("spill_host_bytes", 0) or 0)
     sp_d = int(rec.get("spill_disk_bytes", 0) or 0)
     if sp_h or sp_d:
@@ -100,7 +122,17 @@ def main(argv=None) -> int:
                     help="rewrite the store keeping the N newest records "
                     "(newest per fingerprint always wins)")
     ap.add_argument("--json", action="store_true",
-                    help="emit the folded records as JSON")
+                    help="emit the folded records (with their run "
+                    "aggregates under 'agg') as JSON")
+    ap.add_argument("--regressions", action="store_true",
+                    help="compare each fingerprint's newest run against "
+                    "the aggregate of the runs before it; exit 1 when "
+                    "anything alerts")
+    ap.add_argument("--threshold", type=float, default=4.0,
+                    help="sentinel MAD threshold (default 4.0)")
+    ap.add_argument("--min-runs", type=int, default=3,
+                    help="minimum baseline runs before alerting "
+                    "(default 3)")
     args = ap.parse_args(argv)
 
     if args.prune is not None:
@@ -116,15 +148,37 @@ def main(argv=None) -> int:
     if not records:
         print("no records found in", store.store_path(args.dir))
         return 2
+    aggs = {fp: store.aggregate(args.dir, fp, r.get("conf_sig") or "",
+                                runs=store.AGG_MAX_RUNS)
+            for fp, r in records.items()}
+    if args.regressions:
+        sentinel = _load_sentinel()
+        alerted = 0
+        for fp, rec in sorted(records.items()):
+            runs = store.runs_for(args.dir, fp, rec.get("conf_sig") or "")
+            baseline = store.aggregate_records(runs[:-1])
+            alerts = sentinel.check(rec, baseline, args.threshold,
+                                    args.min_runs)
+            for a in alerts:
+                alerted += 1
+                print(f"REGRESSION fingerprint {fp}: {a['key']} = "
+                      f"{a['value']:g} (median {a['median']:g}, band "
+                      f"{a['band']:g} over {a['runs']} run(s))")
+        if not alerted:
+            print(f"no regressions across {len(records)} "
+                  "fingerprint(s)")
+        return 1 if alerted else 0
     if args.json:
-        print(json.dumps(records, indent=2, sort_keys=True))
+        out = {fp: dict(r, agg=aggs.get(fp))
+               for fp, r in records.items()}
+        print(json.dumps(out, indent=2, sort_keys=True))
         return 0
     recs = sorted(records.values(),
                   key=lambda r: float(r.get("ts", 0) or 0), reverse=True)
     print(f"{len(recs)} plan fingerprint(s) in "
           f"{store.store_path(args.dir)}\n")
     for rec in recs:
-        print(describe(rec))
+        print(describe(rec, aggs.get(str(rec.get("fp")))))
         print()
     return 0
 

@@ -3,7 +3,7 @@
 
 Usage:
     python tools/rapidsprof.py <events.jsonl> [more.jsonl ...]
-        [--top N] [--query ID] [--chrome out.json]
+        [--top N] [--query ID] [--chrome out.json] [--critpath]
 
 Reads the JSONL event log(s) a session wrote under
 ``spark.rapids.sql.tpu.obs.eventLogDir`` and prints, per query and in
@@ -44,6 +44,7 @@ def _load_obs():
 
 
 _obs = _load_obs()
+from rapidsprof_obs import critpath as obs_critpath  # noqa: E402
 from rapidsprof_obs import export as obs_export  # noqa: E402
 from rapidsprof_obs.profile import QueryProfile  # noqa: E402
 
@@ -55,7 +56,10 @@ def load_profiles(paths):
             profiles.append(QueryProfile(
                 q.get("id", i + 1), q.get("events", []),
                 dropped=q.get("dropped", 0), wall_ns=q.get("wall_ns", 0),
-                metrics=q.get("metrics") or {}))
+                metrics=q.get("metrics") or {},
+                dropped_by_site=q.get("dropped_by_site") or {},
+                session_id=q.get("session", 0),
+                qt0_ns=q.get("t0_ns", 0), qt1_ns=q.get("t1_ns", 0)))
     return profiles
 
 
@@ -69,11 +73,25 @@ def _mb(nbytes: int) -> str:
     return f"{nbytes / (1 << 20):.2f} MB"
 
 
-def report(profiles, top_n: int = 10) -> str:
+def report(profiles, top_n: int = 10, critpath: bool = False) -> str:
     lines = []
-    for p in profiles:
-        lines.append(p.summary())
-        lines.append("")
+    # group per-query blocks by the session that ran them (one shared
+    # log accumulates every session in the process)
+    sessions = sorted({p.session_id for p in profiles})
+    grouped = len(sessions) > 1
+    for sid in sessions:
+        if grouped:
+            lines.append(f"== session {sid} ==")
+        for p in profiles:
+            if p.session_id != sid:
+                continue
+            lines.append(p.summary())
+            if critpath:
+                cp = obs_critpath.from_profile(p)
+                lines.append(cp.summary() if cp is not None
+                             else "critical path: (no query window "
+                                  "recorded)")
+            lines.append("")
 
     # aggregate top operators by device time
     merged = {}
@@ -152,12 +170,13 @@ def report(profiles, top_n: int = 10) -> str:
     if len(profiles) > 1:
         lines.append("")
         lines.append("== per-query comparison ==")
-        lines.append("  query | wall ms | device ms | events | dropped | "
-                     "dispatches | shuffle MB")
+        lines.append("  query | sess | wall ms | device ms | events | "
+                     "dropped | dispatches | shuffle MB")
         for p in profiles:
             sh = sum(r["shuffle_bytes"] for r in p.op_rollups.values())
             lines.append(
-                f"  {p.query_id:>5} | {p.wall_ns / 1e6:>7.1f} | "
+                f"  {p.query_id:>5} | {p.session_id:>4} | "
+                f"{p.wall_ns / 1e6:>7.1f} | "
                 f"{p.attributed_device_ns / 1e6:>9.2f} | "
                 f"{p.event_count:>6} | {p.dropped:>7} | "
                 f"{p.site('dispatch')['count']:>10} | "
@@ -175,6 +194,9 @@ def main(argv=None) -> int:
                     help="restrict to one query id")
     ap.add_argument("--chrome", default=None, metavar="OUT",
                     help="also write a Chrome trace_event JSON")
+    ap.add_argument("--critpath", action="store_true",
+                    help="print each query's exact critical-path "
+                         "decomposition")
     args = ap.parse_args(argv)
 
     profiles = load_profiles(args.logs)
@@ -183,7 +205,7 @@ def main(argv=None) -> int:
     if not profiles:
         print("no queries found in", ", ".join(args.logs))
         return 2
-    print(report(profiles, args.top))
+    print(report(profiles, args.top, critpath=args.critpath))
     if args.chrome:
         events = [ev for p in profiles for ev in p.events]
         obs_export.write_chrome_trace(args.chrome, events)

@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""rapidstop — "top" for a running (or finished) rapids engine process.
+
+Usage:
+    python tools/rapidstop.py <telemetry.jsonl> [more.jsonl ...]
+        [--once] [--follow] [--last N] [--prom]
+
+Reads the telemetry JSONL a session flushes under
+``spark.rapids.sql.tpu.obs.eventLogDir`` (``telemetry-<pid>.jsonl``,
+written by obs.timeseries) and renders the newest interval's per-site
+activity table — events, wall, bytes, derived GB/s — plus the gauge
+samples (catalog tier bytes, spill-writer/decode-pool utilization,
+serve queue depth) and a window rollup.  ``--follow`` re-renders as the
+live process appends intervals; ``--prom`` emits Prometheus exposition
+text summed over the window instead (pipe it to a textfile collector).
+
+Runtime-free by construction (the same loading discipline as
+``rapidslint``/``rapidsprof``): the ``obs`` package is loaded standalone
+without executing the engine's root ``__init__``, so no jax import and
+no device runtime — watch a TPU host's flushes from any laptop.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: --follow re-render cadence; also the bounded sleep slice (R3).
+_POLL_SLICE_S = 0.25
+
+
+def _load_obs():
+    """Load spark_rapids_tpu.obs WITHOUT executing the engine's package
+    __init__ (which imports jax) — obs is stdlib-only and relative-
+    imported precisely so this tool stays runtime-free."""
+    pkg_dir = os.path.join(REPO_ROOT, "spark_rapids_tpu", "obs")
+    spec = importlib.util.spec_from_file_location(
+        "rapidstop_obs", os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["rapidstop_obs"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_obs = _load_obs()
+from rapidstop_obs import timeseries as ts  # noqa: E402
+
+
+def load_intervals(paths):
+    """Concatenate the telemetry logs, oldest interval first (multiple
+    files = multiple processes; idx orders within one ring).  A
+    directory stands for every ``telemetry-*.jsonl`` inside it, so
+    pointing at ``obs.eventLogDir`` itself works."""
+    out = []
+    for path in paths:
+        if os.path.isdir(path):
+            names = sorted(n for n in os.listdir(path)
+                           if n.startswith("telemetry-")
+                           and n.endswith(".jsonl"))
+            files = [os.path.join(path, n) for n in names]
+        else:
+            files = [path]
+        for f in files:
+            try:
+                out.extend(ts.read_telemetry_log(f))
+            except OSError:
+                continue  # not flushed yet (or gone) — render what exists
+    return out
+
+
+def _gauges_latest(intervals):
+    for iv in reversed(intervals):
+        g = iv.get("gauges")
+        if g:
+            return g
+    return {}
+
+
+def render_prom(intervals) -> str:
+    totals = {}
+    for iv in intervals:
+        for site, st in (iv.get("sites") or {}).items():
+            t = totals.setdefault(site, [0, 0, 0])
+            t[0] += int(st[0])
+            t[1] += int(st[1])
+            t[2] += int(st[2])
+    return ts.render_prometheus(totals, _gauges_latest(intervals),
+                                len(intervals))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="live per-site telemetry view over rapids "
+                    "telemetry JSONL flushes")
+    ap.add_argument("logs", nargs="+", help="telemetry JSONL path(s) "
+                    "(telemetry-<pid>.jsonl under obs.eventLogDir)")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit (default)")
+    ap.add_argument("--follow", action="store_true",
+                    help="keep re-rendering as intervals land (^C to "
+                    "stop)")
+    ap.add_argument("--last", type=int, default=0, metavar="N",
+                    help="window rollup over only the last N intervals")
+    ap.add_argument("--prom", action="store_true",
+                    help="emit Prometheus exposition text instead of "
+                    "the table")
+    args = ap.parse_args(argv)
+
+    def frame() -> str:
+        intervals = load_intervals(args.logs)
+        if args.prom:
+            return render_prom(intervals)
+        return ts.render_intervals(intervals, last=args.last)
+
+    if not args.follow:
+        out = frame()
+        print(out)
+        return 0 if "(no telemetry intervals)" not in out else 2
+    try:
+        while True:
+            print("\x1b[2J\x1b[H" + frame(), flush=True)
+            time.sleep(_POLL_SLICE_S)
+    except KeyboardInterrupt:
+        sys.exit(0)  # clean ^C out of --follow
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # downstream pager/head closed the pipe — normal for a CLI
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)
