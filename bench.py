@@ -612,6 +612,20 @@ def time_serve():
                            max_concurrency=2)
 
 
+def time_frontend():
+    """Network front-door lane (serve/frontend): the demo SQL workload
+    through a real TCP socket — queries/sec and client-observed
+    p50/p99 over concurrent per-tenant connections, socket-vs-serial
+    wall ratio, bit-parity against in-process execution, the second
+    client connection's compile count (must be 0), warm-repeat result
+    cache hits (zero compiles AND zero dispatches) and the admission
+    controller's sentinel-predicted deadline shed."""
+    from spark_rapids_tpu.serve.bench import run_frontend_bench
+    return run_frontend_bench(queries=24, rows=2048,
+                              tenants={"a": 2.0, "b": 1.0},
+                              max_concurrency=2)
+
+
 def time_spill():
     """Spill engine microbench: pre-stage device batches (untimed), then
     register them against a budget that forces most to spill to host and
@@ -781,6 +795,7 @@ def main():
     spill_gbps, spill_sync_gbps, spill_speedup, spill_depth = time_spill()
     aqe_rps, aqe_speedup, aqe_parity, aqe_counters = time_adaptive()
     serve = time_serve()
+    frontend = time_frontend()
     history_speedup, history_hits, history_alerts = time_history()
     mesh_curve, mesh_ratio, mesh_backend = time_mesh()
 
@@ -871,6 +886,23 @@ def main():
         "serve_second_session_compiles":
             serve["serve_second_session_compiles"],
         "serve_tenants": serve["serve_tenants"],
+        # network front-door lane (serve/frontend): the same serving
+        # guarantees over a real TCP socket — out-of-process clients'
+        # queries/sec and observed latency, socket-vs-serial ratio,
+        # bit-parity vs in-process rows, the second client connection's
+        # compile count (0 = the shared plan cache spans connections),
+        # warm-repeat result cache hits (each answered with zero
+        # compiles and zero dispatches) and sentinel-driven admission
+        # sheds (a predicted deadline miss failed fast, pre-execution)
+        "frontend_queries_per_sec": frontend["frontend_queries_per_sec"],
+        "frontend_p50_ms": frontend["frontend_p50_ms"],
+        "frontend_p99_ms": frontend["frontend_p99_ms"],
+        "frontend_vs_serial": frontend["frontend_vs_serial"],
+        "frontend_parity": frontend["frontend_parity"],
+        "frontend_second_client_compiles":
+            frontend["frontend_second_client_compiles"],
+        "result_cache_hits": frontend["result_cache_hits"],
+        "admission_shed": frontend["admission_shed"],
         # query-intelligence lane (history/): warm-vs-cold wall ratio on
         # the same aggregation (both runs compile-free — the warm run
         # serves the whole subtree from the cross-query fragment cache

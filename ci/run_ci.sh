@@ -79,7 +79,11 @@ for key in ("value", "donated_bytes", "h2d_gb_per_sec", "d2h_gb_per_sec",
             "mesh_spmd_vs_hostdriven", "mesh_backend",
             "history_warm_speedup", "fragment_cache_hits",
             "telemetry_overhead_pct", "critpath_top_site",
-            "regression_alerts"):
+            "regression_alerts",
+            "frontend_queries_per_sec", "frontend_p50_ms",
+            "frontend_p99_ms", "frontend_vs_serial", "frontend_parity",
+            "frontend_second_client_compiles", "result_cache_hits",
+            "admission_shed"):
     assert key in j, f"bench JSON missing {key}: {sorted(j)}"
 assert isinstance(j["critpath_top_site"], str) and j["critpath_top_site"], j
 assert isinstance(j["telemetry_overhead_pct"], float), j
@@ -93,6 +97,10 @@ assert j["aqe_coalesced_partitions"] > 0, j
 assert j["serve_parity"] is True, j
 assert j["serve_batched_queries"] > 0, j
 assert j["serve_second_session_compiles"] == 0, j
+assert j["frontend_parity"] is True, j
+assert j["frontend_second_client_compiles"] == 0, j
+assert j["result_cache_hits"] > 0, j
+assert float(j["frontend_queries_per_sec"]) > 0, j
 assert isinstance(j["mesh_rows_per_sec_by_devices"], dict), j
 assert j["fragment_cache_hits"] > 0, j
 assert j["history_warm_speedup"] > 0, j
@@ -140,6 +148,113 @@ print("serve smoke ok:", {k: j[k] for k in (
     "serve_queries_per_sec", "serve_p50_ms", "serve_p99_ms",
     "serve_batched_queries", "serve_faults_injected", "serve_retries",
     "serve_second_session_compiles")})
+PY
+
+echo "== front-door smoke: rapidsserve --server subprocess, 2 weighted"
+echo "   tenants x concurrent socket clients — row parity vs in-process,"
+echo "   second-client compileCount == 0, warm repeat served from the"
+echo "   result cache, doomed deadline shed without executing, clean"
+echo "   drain with held_depth == 0"
+python - << 'PY'
+import json
+import select
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+
+from spark_rapids_tpu.serve.bench import frontend_demo_session
+from spark_rapids_tpu.serve.scheduler import DeadlineExceeded
+from spark_rapids_tpu.serve.protocol import FrontDoorClient
+
+hist_dir = tempfile.mkdtemp(prefix="rapids_frontdoor_smoke_")
+proc = subprocess.Popen(
+    [sys.executable, "tools/rapidsserve.py", "--server", "--port", "0",
+     "--tenants", "a:2,b:1", "--concurrency", "2", "--rows", "512",
+     "--history-dir", hist_dir],
+    stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+try:
+    # the banner is the FIRST stdout line; session build takes a while
+    ready, _, _ = select.select([proc.stdout], [], [], 300)
+    assert ready, "server printed no banner within 300s"
+    banner = json.loads(proc.stdout.readline())
+    host, port, sqls = banner["host"], banner["port"], banner["sqls"]
+
+    def rows_of(batch):
+        cols = batch.to_pydict()
+        return sorted(zip(*[cols[n] for n in batch.schema.names]))
+
+    # in-process oracle: same deterministic demo view, same SQL texts
+    oracle = frontend_demo_session({"a": 2.0, "b": 1.0}, rows=512)
+    want = {sql: rows_of(oracle.execute(oracle.sql(sql).plan))
+            for sql in sqls}
+
+    # warm passes bypassing the result cache: compile once AND seed the
+    # admission predictor's history baseline (minRuns real executions)
+    with FrontDoorClient(host, port) as c:
+        for _ in range(3):
+            for sql in sqls:
+                batch, _m = c.submit_sql(sql, tenant="a", cache=False)
+                assert rows_of(batch) == want[sql], sql
+
+    # concurrent storm: one socket client per weighted tenant
+    errs = []
+    def storm(tenant):
+        try:
+            with FrontDoorClient(host, port) as c:
+                for sql in sqls:
+                    batch, _m = c.submit_sql(sql, tenant=tenant)
+                    assert rows_of(batch) == want[sql], (tenant, sql)
+        except Exception as e:  # surfaced below; threads must not die silently
+            errs.append((tenant, repr(e)))
+    threads = [threading.Thread(target=storm, args=(t,)) for t in ("a", "b")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    assert not errs, errs
+
+    with FrontDoorClient(host, port) as c:
+        # a brand-new connection is a "second client": the prepared-
+        # statement + shared plan caches must hand it warm executables
+        batch, m = c.submit_sql(sqls[0], tenant="b", cache=False)
+        assert rows_of(batch) == want[sqls[0]]
+        assert m.get("compileCount", 0) == 0, m
+        # warm repeat: served from the result cache, no dispatch at all
+        batch, m = c.submit_sql(sqls[0], tenant="b")
+        assert rows_of(batch) == want[sqls[0]]
+        assert m.get("resultCacheHits", 0) > 0, m
+        assert m.get("dispatchCount", 0) == 0, m
+        # doomed deadline: the admission predictor sheds it fail-fast
+        try:
+            c.submit_sql(sqls[1], tenant="a", deadline_sec=1e-6,
+                         cache=False)
+            raise AssertionError("doomed deadline was not shed")
+        except DeadlineExceeded:
+            pass
+        st = c.stats()
+        assert st["frontend"]["admission_shed"] >= 1, st["frontend"]
+        assert st["frontend"]["result_cache_hits"] >= 1, st["frontend"]
+        assert st["scheduler"]["tenants"]["a"]["completed"] >= 1, st
+        assert st["scheduler"]["tenants"]["b"]["completed"] >= 1, st
+        d = c.drain()
+        assert d["drained"] is True, d
+        assert d["held_depth"] == 0, d
+        print("front-door smoke ok:", {
+            "port": port, "queries": 3 * len(sqls) + 2 * len(sqls) + 2,
+            "admission_shed": st["frontend"]["admission_shed"],
+            "result_cache_hits": st["frontend"]["result_cache_hits"]})
+finally:
+    proc.send_signal(signal.SIGTERM)
+    try:
+        proc.wait(30)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait(30)
+    shutil.rmtree(hist_dir, ignore_errors=True)
+assert proc.returncode == 0, (proc.returncode, proc.stderr.read()[-3000:])
 PY
 
 echo "== obs smoke: event log -> rapidsprof report + Perfetto-loadable trace"

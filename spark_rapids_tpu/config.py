@@ -609,6 +609,77 @@ SERVE_PLAN_CACHE_MAX = conf_int(
     "(serve.excache) — entries pin their physical plans and compiled "
     "stage programs; past the bound the least-recently-hit plan is "
     "dropped (its executables fall out with it).")
+SERVE_BATCH_ADAPTIVE = conf_bool(
+    "spark.rapids.sql.tpu.serve.batch.adaptive.enabled", False,
+    "Adaptive micro-batch linger (serve.scheduler): instead of the "
+    "static serve.batch.maxDelayMs window, size each linger from the "
+    "telemetry ring's observed arrival rate — roughly two expected "
+    "inter-arrival gaps, clamped to [0, maxDelayMs] — so an idle server "
+    "dispatches immediately and a busy one waits just long enough for "
+    "the stragglers that are statistically coming.  Falls back to the "
+    "static window while telemetry is disabled.")
+SERVE_FRONTEND_HOST = conf_str(
+    "spark.rapids.sql.tpu.serve.frontend.host", "127.0.0.1",
+    "Interface the serve front door (serve.frontend) binds.  The "
+    "loopback default keeps the server private to the machine; bind a "
+    "routable address only behind real network controls — the NDJSON "
+    "protocol itself is unauthenticated.")
+SERVE_FRONTEND_PORT = conf_int(
+    "spark.rapids.sql.tpu.serve.frontend.port", 0,
+    "TCP port of the serve front door.  0 (default) binds an ephemeral "
+    "port; read it back from FrontDoorServer.port (tools/rapidsserve.py "
+    "--server prints it on its banner line).")
+SERVE_FRONTEND_MAX_LINE = conf_bytes(
+    "spark.rapids.sql.tpu.serve.frontend.maxLineBytes", 64 << 20,
+    "Largest single protocol line (one NDJSON request or response) the "
+    "front door will read or a client will accept — bounds per-request "
+    "buffering against a runaway or malicious peer.  Submissions "
+    "carrying inline columnar data must fit under it.")
+SERVE_RESULT_CACHE_ENABLED = conf_bool(
+    "spark.rapids.sql.tpu.serve.resultCache.enabled", True,
+    "Front-door query result cache (serve.resultcache): final result "
+    "sets keyed by (plan fingerprint, conf signature, input identity) "
+    "kept as catalog-registered spillable batches, so a repeat query "
+    "over unchanged inputs answers with zero compiles and zero "
+    "dispatches.  Invalidation follows the fragment-cache rules: input "
+    "mtime/size change, plan-relevant conf change, device-generation "
+    "bump.  Per-request opt-out via the protocol's cache flag.")
+SERVE_RESULT_CACHE_MAX_ENTRIES = conf_int(
+    "spark.rapids.sql.tpu.serve.resultCache.maxEntries", 64,
+    "LRU entry bound on the front-door result cache.")
+SERVE_RESULT_CACHE_MAX_BYTES = conf_bytes(
+    "spark.rapids.sql.tpu.serve.resultCache.maxBytes", 128 << 20,
+    "Payload-byte bound on the front-door result cache (device bytes of "
+    "the cached result batches, LRU-evicted past the bound).  <= 0 "
+    "disables insertion while still serving existing entries' "
+    "invalidation semantics.")
+SERVE_RESULT_CACHE_MIN_NS_PER_BYTE = conf_float(
+    "spark.rapids.sql.tpu.serve.resultCache.minNsPerByte", 10.0,
+    "Cost-weighted admission floor for the result cache: a result is "
+    "cached only when its recorded compute wall (ns) >= this many ns "
+    "per payload byte — cheap-to-recompute bulky results (e.g. a "
+    "projection of the whole input) are not worth the HBM/spill "
+    "footprint they would occupy.  0 admits everything.")
+SERVE_ADMISSION_ENABLED = conf_bool(
+    "spark.rapids.sql.tpu.serve.admission.enabled", True,
+    "Sentinel-driven admission control at the front door: before "
+    "executing a deadlined query, consult the history store's "
+    "median/MAD wall-time aggregate for its plan fingerprint and shed "
+    "it (fail fast with DeadlineExceeded, counted per tenant as "
+    "admissionShed) when the prediction already misses the deadline.  "
+    "Inactive without a history dir; queries with no deadline or no "
+    "baseline are never shed.")
+SERVE_ADMISSION_MIN_RUNS = conf_int(
+    "spark.rapids.sql.tpu.serve.admission.minRuns", 3,
+    "Minimum history-store runs of a fingerprint before admission "
+    "control trusts its wall-time prediction — below this an unknown "
+    "query always executes (same thin-baseline rule as the regression "
+    "sentinel).")
+SERVE_ADMISSION_MAD_K = conf_float(
+    "spark.rapids.sql.tpu.serve.admission.madK", 3.0,
+    "Admission prediction = median + K * MAD of the fingerprint's "
+    "recorded wall_ns: K widens the band so run-to-run noise does not "
+    "shed queries that usually make their deadline.")
 HISTORY_ENABLED = conf_bool(
     "spark.rapids.sql.tpu.history.enabled", True,
     "Master switch for the query-intelligence layer (history/): the "

@@ -70,6 +70,33 @@ def input_identity(plan) -> Optional[str]:
     return "|".join(parts)
 
 
+def predicted_wall_ns(conf, fp_hash: str, conf_sig: str,
+                      min_runs: int = 3,
+                      mad_k: float = 3.0) -> Optional[float]:
+    """Sentinel-style latency prediction for front-door admission
+    control (serve.frontend): median + ``mad_k`` * MAD of the history
+    store's recorded wall_ns for this (fingerprint, conf-signature).
+    None — never shed — when the history subsystem is off, the baseline
+    is thinner than ``min_runs``, or the recorded medians are zero."""
+    from spark_rapids_tpu.config import (
+        HISTORY_AGGREGATE_RUNS, HISTORY_STORE_MAX_RECORDS,
+    )
+    d = history_dir(conf)
+    if d is None:
+        return None
+    agg = store.aggregate(
+        d, fp_hash, conf_sig,
+        runs=HISTORY_AGGREGATE_RUNS.get(conf),
+        max_records=HISTORY_STORE_MAX_RECORDS.get(conf))
+    if agg.get("n", 0) < max(1, int(min_runs)):
+        return None
+    wall = agg.get("keys", {}).get("wall_ns") or {}
+    median = float(wall.get("median", 0.0))
+    if median <= 0:
+        return None
+    return median + float(mad_k) * float(wall.get("mad", 0.0))
+
+
 def begin_query(session, plan, phys, ctx) -> None:
     """Arm the history hooks for one execution: consult the store to
     seed the physical plan (once per plan object) and put the fragment

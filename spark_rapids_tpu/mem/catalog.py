@@ -76,6 +76,10 @@ PRIORITY_ON_DECK = 1000
 # spillable band — a cached fragment is a speculative reuse bet and must
 # yield HBM before any live query's inputs or shuffle outputs.
 PRIORITY_FRAGMENT = -2000
+# Front-door result-cache entries (serve.resultcache): below even
+# fragments — a final result set was already delivered to its client, so
+# keeping it resident is the purest reuse bet of all and yields first.
+PRIORITY_RESULT = -3000
 
 #: Bounded wait slice (seconds) for every blocking loop in this module:
 #: notify still wakes immediately, the bound only caps the C-level block so

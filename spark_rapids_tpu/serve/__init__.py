@@ -1,22 +1,35 @@
 """Serving runtime: a multi-tenant scheduler layered above
 ``session.execute`` (serve.scheduler), the process-wide shared
 plan/executable cache it amortizes compiles through (serve.excache),
-and micro-query batching for template workloads (serve.batching).
+micro-query batching for template workloads (serve.batching), and the
+out-of-process network front door (serve.frontend / serve.protocol)
+with its final-result cache (serve.resultcache).
 See docs/serving.md.
 """
 
 from spark_rapids_tpu.serve.batching import MicroBatcher, QueryTemplate
 from spark_rapids_tpu.serve.excache import SharedPlanCache, shared_plan_cache
+from spark_rapids_tpu.serve.frontend import FrontDoorServer
+from spark_rapids_tpu.serve.protocol import FrontDoorClient, FrontDoorError
+from spark_rapids_tpu.serve.resultcache import (
+    ResultCache, cache_key, result_cache,
+)
 from spark_rapids_tpu.serve.scheduler import (
     DeadlineExceeded, ServeFuture, ServeScheduler,
 )
 
 __all__ = [
     "DeadlineExceeded",
+    "FrontDoorClient",
+    "FrontDoorError",
+    "FrontDoorServer",
     "MicroBatcher",
     "QueryTemplate",
+    "ResultCache",
     "ServeFuture",
     "ServeScheduler",
     "SharedPlanCache",
+    "cache_key",
+    "result_cache",
     "shared_plan_cache",
 ]

@@ -1,0 +1,269 @@
+"""Front-door query result cache (serve/).
+
+The fragment cache (history/fragcache.py) memoizes *intermediate*
+device fragments inside one process's execute path; this cache extends
+the same key and invalidation rules to **final result sets** served by
+the network front door (serve/frontend.py), so a repeat query over
+unchanged inputs answers an out-of-process client with zero compiles
+AND zero dispatches — the response is rebuilt from catalog-registered
+spillable batches without ever entering ``session.execute``.
+
+Key: ``(plan fingerprint hash, plan-relevant conf signature, input
+identity)`` — exactly the fragment-cache key (history.input_identity).
+Invalidation therefore follows the same three edges:
+
+* **input mtime/size**: the key is recomputed per request from a live
+  ``os.stat`` of every scanned file, so an overwritten input produces a
+  different key and misses naturally;
+* **conf signature**: any plan-relevant conf change (history.store's
+  ``conf_signature`` exclusions aside) changes the key;
+* **device generation**: entries record the DeviceRuntime generation
+  they were built under; a device-lost recovery bump drops them on the
+  next fetch.
+
+Entries hold a STRONG reference to the logical plan — a deliberate
+deviation from the fragment cache's weakref discipline.  Front-door
+plans are parsed per request and would die the moment the response is
+sent, yet the id()-keyed parts of the fingerprint and input identity
+(InMemoryScan batch holders) stay sound only while the plan tree that
+owns them is alive.  Pinning the plan keeps them sound; the LRU entry
+and byte bounds keep the pin bounded.
+
+**Cost-weighted admission**: a result is cached only when its recorded
+compute wall beats its byte footprint
+(``serve.resultCache.minNsPerByte``) — a cheap-to-recompute bulky
+result (a full-input projection, say) would evict genuinely expensive
+results for no latency win.
+
+Storage: the result HostBatch is staged to device once and registered
+in the spill catalog at PRIORITY_RESULT — the most spillable band, so
+cached results yield HBM before any live query data and before even
+fragment-cache entries.  A hit rehydrates through the catalog
+prefetcher and runs only D2H.
+
+Thread safety: bookkeeping under one lock; staging, registration and
+victim closing run outside it (fragcache discipline).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, List, Optional, Tuple
+
+DEFAULT_MAX_ENTRIES = 64
+DEFAULT_MAX_BYTES = 128 << 20
+DEFAULT_MIN_NS_PER_BYTE = 10.0
+
+
+class _Result:
+    __slots__ = ("plan", "handles", "generation", "nbytes", "wall_ns")
+
+    def __init__(self, plan, handles, generation, nbytes, wall_ns):
+        self.plan = plan
+        self.handles = handles
+        self.generation = generation
+        self.nbytes = nbytes
+        self.wall_ns = wall_ns
+
+
+def cache_key(session, plan) -> Tuple[str, str, Optional[str]]:
+    """(fingerprint hash, conf signature, input identity | None) for
+    ``plan`` under ``session``'s conf — the identity triple shared by
+    the result cache and the admission predictor.  The input identity
+    is None (uncacheable) when a source kind is unknown or an input
+    file went missing."""
+    from spark_rapids_tpu.history import input_identity
+    from spark_rapids_tpu.history import store
+    from spark_rapids_tpu.plan.logical import plan_fingerprint
+    fp_hash = store.fingerprint_hash(plan_fingerprint(plan))
+    conf_sig = store.conf_signature(session.conf._settings.items())
+    return fp_hash, conf_sig, input_identity(plan)
+
+
+class ResultCache:
+    """LRU of final result sets, shared by every front door in the
+    process (serve/excache singleton discipline)."""
+
+    def __init__(self, max_entries: int = DEFAULT_MAX_ENTRIES,
+                 max_bytes: int = DEFAULT_MAX_BYTES,
+                 min_ns_per_byte: float = DEFAULT_MIN_NS_PER_BYTE):
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[Any, _Result]" = OrderedDict()
+        self._max_entries = max(1, int(max_entries))
+        self._max_bytes = int(max_bytes)
+        self._min_ns_per_byte = float(min_ns_per_byte)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.admission_rejects = 0
+
+    def configure(self, max_entries: int, max_bytes: int,
+                  min_ns_per_byte: float) -> None:
+        with self._lock:
+            self._max_entries = max(1, int(max_entries))
+            self._max_bytes = int(max_bytes)
+            self._min_ns_per_byte = float(min_ns_per_byte)
+            victims = self._evict_locked()
+        self._close_all(victims)
+
+    # -- internal -----------------------------------------------------------
+
+    def _evict_locked(self) -> List[_Result]:
+        """Collect LRU victims past either bound; caller closes them
+        OUTSIDE the lock."""
+        victims: List[_Result] = []
+        total = sum(e.nbytes for e in self._entries.values())
+        while self._entries and (
+                len(self._entries) > self._max_entries
+                or total > max(0, self._max_bytes)):
+            _, ent = self._entries.popitem(last=False)
+            total -= ent.nbytes
+            victims.append(ent)
+            self.evictions += 1
+        return victims
+
+    @staticmethod
+    def _close_all(results: List[_Result]) -> None:
+        for ent in results:
+            for h in ent.handles:
+                h.close()
+
+    # -- public -------------------------------------------------------------
+
+    def fetch(self, key: Any):
+        """The cached result as a fresh HostBatch, or None on miss.
+
+        A hit rehydrates the catalog handles (overlapped unspill via the
+        prefetcher) and runs only D2H — no compile, no dispatch, no
+        device admission.  A generation mismatch or any rehydration
+        failure drops the entry and reports a miss (the front door then
+        executes normally and re-inserts)."""
+        from spark_rapids_tpu.runtime.device import DeviceRuntime
+        gen_now = DeviceRuntime.generation()
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is not None and ent.generation != gen_now:
+                del self._entries[key]
+                self.misses += 1
+                stale = ent
+            elif ent is None:
+                self.misses += 1
+                return None
+            else:
+                self._entries.move_to_end(key)
+                stale = None
+        if stale is not None:
+            self._close_all([stale])
+            return None
+        from spark_rapids_tpu.batch import HostBatch, device_to_host_many
+        from spark_rapids_tpu.plan.physical import prefetch_spillables
+        try:
+            devs = list(prefetch_spillables(ent.handles, depth=1))
+            hosts = device_to_host_many(devs)
+        except Exception:
+            # DeviceLostError racing past the generation check, a handle
+            # closed by a concurrent eviction, an unspill failure — drop
+            # the entry and let the front door execute normally
+            with self._lock:
+                if self._entries.get(key) is ent:
+                    del self._entries[key]
+                self.misses += 1
+            self._close_all([ent])
+            return None
+        with self._lock:
+            self.hits += 1
+        from spark_rapids_tpu.obs import events as obs_events
+        obs_events.emit_instant("serve.resultcache", "result_hit", "serve",
+                                bytes=ent.nbytes, batches=len(hosts))
+        return HostBatch.concat(hosts)
+
+    def insert(self, key: Any, plan: Any, result, wall_ns: int,
+               conf) -> bool:
+        """Adopt a finished query's result HostBatch under ``key``.
+
+        Applies cost-weighted admission first (recorded compute wall
+        must beat the byte footprint at ``minNsPerByte``), then stages
+        the rows to device once and registers them as a catalog
+        spillable at PRIORITY_RESULT.  First insert wins on a race.
+        Returns False when not admitted."""
+        if key is None or result is None or result.num_rows == 0:
+            return False
+        from spark_rapids_tpu.batch import host_batch_bytes
+        nbytes = host_batch_bytes(result)
+        with self._lock:
+            if self._max_bytes <= 0:
+                return False
+            if self._min_ns_per_byte > 0 and \
+                    wall_ns < self._min_ns_per_byte * nbytes:
+                self.admission_rejects += 1
+                return False
+            if key in self._entries:
+                return False
+        from spark_rapids_tpu.batch import host_to_device
+        from spark_rapids_tpu.mem.catalog import (
+            PRIORITY_RESULT, device_batch_bytes,
+        )
+        from spark_rapids_tpu.runtime.device import DeviceRuntime
+        try:
+            dev = host_to_device(result)
+            nbytes = device_batch_bytes(dev)
+        except Exception:
+            # a result shape the device layout cannot hold (e.g. a
+            # host-only array<string> column) is simply not cacheable
+            return False
+        rt = DeviceRuntime.get(conf)
+        handle = rt.catalog.register(dev, priority=PRIORITY_RESULT)
+        ent = _Result(plan, [handle], DeviceRuntime.generation(),
+                      nbytes, int(wall_ns))
+        with self._lock:
+            if key in self._entries:
+                loser: Optional[_Result] = ent  # racer won; drop ours
+                victims: List[_Result] = []
+            else:
+                self._entries[key] = ent
+                self._entries.move_to_end(key)
+                loser = None
+                victims = self._evict_locked()
+        if loser is not None:
+            self._close_all([loser])
+            return False
+        self._close_all(victims)
+        return True
+
+    def drop(self, key: Any) -> None:
+        with self._lock:
+            ent = self._entries.pop(key, None)
+        if ent is not None:
+            self._close_all([ent])
+
+    def clear(self) -> None:
+        with self._lock:
+            victims = list(self._entries.values())
+            self._entries.clear()
+        self._close_all(victims)
+
+    def stats(self):
+        with self._lock:
+            return {
+                "result_cache_entries": len(self._entries),
+                "result_cache_bytes": sum(
+                    e.nbytes for e in self._entries.values()),
+                "result_cache_hits": self.hits,
+                "result_cache_misses": self.misses,
+                "result_cache_evictions": self.evictions,
+                "result_cache_admission_rejects": self.admission_rejects,
+            }
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
+
+
+_SHARED: ResultCache = ResultCache()
+
+
+def result_cache() -> ResultCache:
+    """The process singleton (serve/excache.shared_plan_cache
+    analogue)."""
+    return _SHARED

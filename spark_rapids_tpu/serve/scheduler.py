@@ -25,7 +25,9 @@ Spark's fair-scheduler pools over the rapids plugin:
   :mod:`spark_rapids_tpu.serve.batching`.  A runner popping a micro
   query drains every queued group partner (each charged to its own
   tenant's vtime) and may linger up to ``serve.batch.maxDelayMs`` for
-  stragglers before dispatching once for all of them.
+  stragglers before dispatching once for all of them — or, with
+  ``serve.batch.adaptive.enabled``, an arrival-rate-driven linger
+  clamped to [0, maxDelayMs] (see :meth:`_adaptive_delay_s`).
 
 Blocking discipline (rapidslint R2/R3): every wait is a bounded
 <=0.25s slice inside a loop with an exit condition; every lock acquire
@@ -143,6 +145,7 @@ class _Tenant:
         self.completed = 0
         self.failed = 0
         self.deadline_exceeded = 0
+        self.inflight = 0
         self.latencies_ms: List[float] = []
 
     def charge(self) -> None:
@@ -185,9 +188,9 @@ class ServeScheduler:
     def __init__(self, session, max_concurrency: Optional[int] = None,
                  autostart: bool = True):
         from spark_rapids_tpu.config import (
-            SERVE_BATCH_ENABLED, SERVE_BATCH_MAX_DELAY_MS,
-            SERVE_BATCH_MAX_QUERIES, SERVE_DEADLINE_SEC,
-            SERVE_MAX_CONCURRENCY,
+            SERVE_BATCH_ADAPTIVE, SERVE_BATCH_ENABLED,
+            SERVE_BATCH_MAX_DELAY_MS, SERVE_BATCH_MAX_QUERIES,
+            SERVE_DEADLINE_SEC, SERVE_MAX_CONCURRENCY,
         )
         self.session = session
         self.conf = session.conf
@@ -195,6 +198,7 @@ class ServeScheduler:
                                 or SERVE_MAX_CONCURRENCY.get(self.conf))
         self._batch_enabled = SERVE_BATCH_ENABLED.get(self.conf)
         self._batch_delay_s = SERVE_BATCH_MAX_DELAY_MS.get(self.conf) / 1e3
+        self._batch_adaptive = SERVE_BATCH_ADAPTIVE.get(self.conf)
         self._batch_max = max(1, SERVE_BATCH_MAX_QUERIES.get(self.conf))
         self._default_deadline = SERVE_DEADLINE_SEC.get(self.conf)
         self._batcher = MicroBatcher(session)
@@ -205,6 +209,10 @@ class ServeScheduler:
         self._qid_seq = 0
         self._inflight = 0
         self._runners: List[threading.Thread] = []
+        # per-tenant gauges created in _tenant() (caller holds our lock)
+        # are registered later, outside it — never call into the
+        # telemetry registry while holding the scheduler lock
+        self._pending_gauges: List[Tuple[str, Any]] = []
         if autostart:
             self.start()
 
@@ -244,7 +252,27 @@ class ServeScheduler:
             if self._tenants:
                 t.vtime = min(x.vtime for x in self._tenants.values())
             self._tenants[name] = t
+            self._pending_gauges.extend([
+                (f"serve.tenant.{name}.queue_depth",
+                 lambda t=t: float(len(t.queue))),
+                (f"serve.tenant.{name}.inflight",
+                 lambda t=t: float(t.inflight)),
+                (f"serve.tenant.{name}.deadline_miss",
+                 lambda t=t: float(t.deadline_exceeded)),
+            ])
         return t
+
+    def _flush_tenant_gauges(self) -> None:
+        """Register any gauges queued by _tenant() (outside the lock).
+        While telemetry is down, registration would be a silent no-op —
+        keep them pending until a ring exists to adopt them."""
+        from spark_rapids_tpu.obs import timeseries as obs_ts
+        if obs_ts.ring() is None:
+            return
+        with self._lock:
+            pending, self._pending_gauges = self._pending_gauges, []
+        for name, fn in pending:
+            obs_ts.register_gauge(name, fn)
 
     def _enqueue(self, item: _Item, tenant: str) -> ServeFuture:
         with self._work:
@@ -254,7 +282,23 @@ class ServeScheduler:
             t.submitted += 1
             t.queue.append(item)
             self._work.notify()
+        self._flush_tenant_gauges()
+        # arrival marker for the adaptive micro-batch window: the ring's
+        # sample count over its window IS the arrival rate estimate
+        from spark_rapids_tpu.obs import timeseries as obs_ts
+        obs_ts.record_value("serve.arrivals", 1.0)
         return item.future
+
+    def record_shed(self, tenant: str) -> None:
+        """Count an admission-control shed (serve/frontend.py) against
+        ``tenant``'s SLO rollup: the query was submitted to the front
+        door and failed its deadline — it just never reached a queue."""
+        with self._lock:
+            t = self._tenant(tenant)
+            t.submitted += 1
+            t.failed += 1
+            t.deadline_exceeded += 1
+        self._flush_tenant_gauges()
 
     def submit(self, query, tenant: str = "default",
                deadline_sec: Optional[float] = None) -> ServeFuture:
@@ -331,6 +375,7 @@ class ServeScheduler:
                     popped = self._pop_locked()
                 tenant, item = popped
                 self._inflight += 1
+                tenant.inflight += 1
             try:
                 if item.template is not None:
                     self._run_micro(tenant, item)
@@ -339,6 +384,7 @@ class ServeScheduler:
             finally:
                 with self._work:
                     self._inflight -= 1
+                    tenant.inflight -= 1
                     self._work.notify_all()
 
     def _expire(self, tenant: _Tenant, item: _Item) -> bool:
@@ -374,6 +420,27 @@ class ServeScheduler:
             tenant.record(item, ok=True)
         item.future._resolve(out, metrics)
 
+    def _adaptive_delay_s(self) -> float:
+        """Arrival-rate-driven micro-batch linger
+        (``serve.batch.adaptive.enabled``): aim to linger about two
+        inter-arrival gaps — long enough to catch the next same-group
+        submission when traffic is steady, and collapsing to zero when
+        the queue has gone quiet (an isolated query shouldn't pay the
+        full maxDelayMs for riders that never come).  Clamped to
+        [0, maxDelayMs]; falls back to the static linger while
+        telemetry is disabled (no arrival estimate to steer by)."""
+        from spark_rapids_tpu.obs import timeseries as obs_ts
+        ring = obs_ts.ring()
+        if ring is None:
+            return self._batch_delay_s
+        window_s = ring.window_seconds()
+        if window_s <= 0:
+            return self._batch_delay_s
+        rate = len(ring.window_values("serve.arrivals")) / window_s
+        if rate <= 0.0:
+            return 0.0
+        return max(0.0, min(self._batch_delay_s, 2.0 / rate))
+
     def _collect_riders(self, head_item: _Item) -> List[Tuple[_Tenant,
                                                               _Item]]:
         """Drain queued group partners of ``head_item``; linger up to
@@ -383,7 +450,9 @@ class ServeScheduler:
         budget = self._batch_max - 1
         if not self._batch_enabled or budget <= 0:
             return riders
-        wait_deadline = time.monotonic() + self._batch_delay_s
+        delay_s = self._adaptive_delay_s() if self._batch_adaptive \
+            else self._batch_delay_s
+        wait_deadline = time.monotonic() + delay_s
         while True:
             with self._work:
                 riders.extend(
@@ -508,6 +577,7 @@ class ServeScheduler:
                     "completed": t.completed,
                     "failed": t.failed,
                     "deadline_exceeded": t.deadline_exceeded,
+                    "inflight": t.inflight,
                     "p50_ms": _percentile(sorted(t.latencies_ms), 0.50),
                     "p99_ms": _percentile(sorted(t.latencies_ms), 0.99),
                     "window_p50_ms": w50,

@@ -4,8 +4,13 @@
 Usage:
     python tools/rapidsserve.py [--tenants a:2,b:1] [--queries N]
         [--rows N] [--concurrency N] [--fault SPEC] [--deadline SEC]
+    python tools/rapidsserve.py --server [--host H] [--port P]
+        [--tenants a:2,b:1] [--concurrency N] [--history-dir DIR]
+    python tools/rapidsserve.py --client HOST:PORT --sql "SELECT ..."
+        [--tenant NAME] [--deadline SEC] [--no-cache] [--stats]
+        [--drain]
 
-Runs the deterministic serving workload from
+Default mode runs the deterministic serving workload from
 ``spark_rapids_tpu.serve.bench`` — template micro-queries round-robined
 across weighted tenants, served concurrently with micro-batching — and
 prints ONE JSON line with the ``serve_*`` metrics: queries/sec, p50/p99
@@ -19,6 +24,14 @@ it and must still return correct rows through the recovery ladder —
 the CI serve smoke drives exactly that.  ``--deadline`` arms a
 per-query deadline (seconds; queries that miss it fail fast with
 DeadlineExceeded and count in ``serve_deadline_exceeded``).
+
+``--server`` starts the network front door (serve/frontend) over the
+demo view (``bench_events(k BIGINT, v BIGINT)``) plus the bench
+template, prints ONE JSON banner line ``{"host", "port", "view",
+"sqls"}`` on stdout, and serves until SIGINT/SIGTERM.  ``--client``
+speaks the newline-delimited JSON protocol (docs/serving.md) to any
+front door: submit one ``--sql`` (rows printed as JSON), or fetch
+``--stats`` / issue ``--drain``.
 """
 
 from __future__ import annotations
@@ -26,9 +39,13 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import sys
+import threading
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WAIT_SLICE_S = 0.25
 
 
 def _parse_tenants(spec: str):
@@ -41,6 +58,57 @@ def _parse_tenants(spec: str):
         name, _, weight = part.partition(":")
         out[name.strip()] = float(weight) if weight else 1.0
     return out
+
+
+def _run_server(args) -> int:
+    from spark_rapids_tpu.serve.bench import (
+        FRONTEND_SQLS, FRONTEND_VIEW, _template, frontend_demo_session,
+    )
+    from spark_rapids_tpu.serve.frontend import FrontDoorServer
+    from spark_rapids_tpu.serve.scheduler import ServeScheduler
+    session = frontend_demo_session(
+        _parse_tenants(args.tenants) or {"default": 1.0},
+        history_dir=args.history_dir, rows=max(64, args.rows))
+    session.conf.set("spark.rapids.sql.tpu.serve.frontend.host", args.host)
+    session.conf.set("spark.rapids.sql.tpu.serve.frontend.port",
+                     str(args.port))
+    server = FrontDoorServer(session, scheduler=ServeScheduler(
+        session, max_concurrency=max(1, args.concurrency)))
+    server.register_template(_template())
+    server.start()
+    # ONE machine-readable banner so a parent process (CI smoke) can
+    # discover the ephemeral port, then serve until signalled
+    print(json.dumps({"host": args.host, "port": server.port,
+                      "view": FRONTEND_VIEW, "sqls": FRONTEND_SQLS}),
+          flush=True)
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_a: stop.set())
+    while not stop.is_set():
+        stop.wait(_WAIT_SLICE_S)
+    server.close()
+    return 0
+
+
+def _run_client(args) -> int:
+    from spark_rapids_tpu.serve.protocol import FrontDoorClient
+    host, _, port = args.client.rpartition(":")
+    with FrontDoorClient(host or "127.0.0.1", int(port)) as c:
+        if args.stats:
+            print(json.dumps(c.stats()))
+            return 0
+        if args.drain:
+            print(json.dumps(c.drain()))
+            return 0
+        if not args.sql:
+            print("rapidsserve --client needs --sql, --stats or --drain",
+                  file=sys.stderr)
+            return 2
+        rows, metrics = c.submit_sql(
+            args.sql, tenant=args.tenant, deadline_sec=args.deadline,
+            cache=not args.no_cache)
+        print(json.dumps({"rows": rows.to_pydict(), "metrics": metrics}))
+    return 0
 
 
 def main(argv=None) -> int:
@@ -58,8 +126,34 @@ def main(argv=None) -> int:
                     help="faults.spec to inject per served query")
     ap.add_argument("--deadline", type=float, default=0.0,
                     help="per-query deadline seconds (0 = off)")
+    ap.add_argument("--server", action="store_true",
+                    help="start the network front door (demo view)")
+    ap.add_argument("--host", default="127.0.0.1",
+                    help="--server bind host (default 127.0.0.1)")
+    ap.add_argument("--port", type=int, default=0,
+                    help="--server bind port (default 0 = ephemeral)")
+    ap.add_argument("--history-dir", default="",
+                    help="--server: history store dir (enables the "
+                         "admission predictor's baseline)")
+    ap.add_argument("--client", default="",
+                    help="HOST:PORT of a front door to talk to")
+    ap.add_argument("--sql", default="",
+                    help="--client: SQL text to submit")
+    ap.add_argument("--tenant", default="default",
+                    help="--client: tenant to submit as")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="--client: bypass the server result cache")
+    ap.add_argument("--stats", action="store_true",
+                    help="--client: print scheduler+frontend stats")
+    ap.add_argument("--drain", action="store_true",
+                    help="--client: drain the server and report "
+                         "held_depth")
     args = ap.parse_args(argv)
     sys.path.insert(0, REPO_ROOT)
+    if args.server:
+        return _run_server(args)
+    if args.client:
+        return _run_client(args)
     from spark_rapids_tpu.serve.bench import run_serve_bench
     result = run_serve_bench(
         queries=max(1, args.queries), rows=max(1, args.rows),
