@@ -75,7 +75,9 @@ for key in ("value", "donated_bytes", "h2d_gb_per_sec", "d2h_gb_per_sec",
             "serve_second_session_compiles", "serve_tenants",
             "scan_gb_per_sec", "scan_decode_gb_per_sec",
             "scan_h2d_overlap_pct", "scan_chunks_skipped",
-            "scan_v2_vs_v1", "mesh_rows_per_sec_by_devices",
+            "scan_v2_vs_v1", "readahead_depth_effective",
+            "shuffle_wire_gb_per_sec", "shuffle_encoded_bytes_saved",
+            "mesh_rows_per_sec_by_devices",
             "mesh_spmd_vs_hostdriven", "mesh_backend",
             "history_warm_speedup", "fragment_cache_hits",
             "telemetry_overhead_pct", "critpath_top_site",
@@ -91,6 +93,8 @@ assert isinstance(j["regression_alerts"], int) and \
     j["regression_alerts"] >= 0, j
 assert j["value"] > 0, j
 assert j["scan_gb_per_sec"] > 0, j
+assert j["shuffle_encoded_bytes_saved"] >= 0, j
+assert j["readahead_depth_effective"] >= 1, j
 assert j["spill_gb_per_sec"] > 0, j
 assert j["aqe_parity"] is True, j
 assert j["aqe_coalesced_partitions"] > 0, j
@@ -597,6 +601,61 @@ assert m["aqeBroadcastSwitches"] >= 1, m
 print("adaptive fault smoke ok:", {k: m[k] for k in (
     "retryCount", "faultsInjected", "aqeBroadcastSwitches",
     "aqeCoalescedPartitions")})
+PY
+
+echo "== fault-injection smoke: scan:oom@2 through the adaptive read-ahead"
+echo "   path — the faulted chunk replays through the retry ladder with"
+echo "   bit-identical rows, dict columns intact, held_depth == 0"
+python - << 'PY'
+import os
+import tempfile
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.session import TpuSparkSession
+
+out = tempfile.mkdtemp(prefix="rapids_scan_fault_smoke_")
+rng = np.random.RandomState(3)
+n = 8192
+cats = np.array([f"c{i:03d}" for i in range(64)], dtype=object)
+pq.write_table(pa.table({
+    "k": pa.array(rng.randint(0, 64, n).astype(np.int64)),
+    "s": pa.array(cats[rng.randint(0, 64, n)]),
+    "v": pa.array((rng.rand(n) * 10).round(3)),
+}), os.path.join(out, "part-00000.parquet"), row_group_size=n // 8)
+
+BASE = {
+    "spark.rapids.sql.enabled": True,
+    "spark.rapids.sql.tpu.scan.v2.enabled": True,
+    "spark.rapids.sql.variableFloatAgg.enabled": True,
+    # adaptive controller live (no explicit depth -> adaptive governs)
+    "spark.rapids.sql.tpu.scan.readAhead.adaptive.enabled": True,
+}
+
+def q(s):
+    from spark_rapids_tpu import functions as F
+    df = s.read.parquet(out)
+    return sorted(map(str, df.filter(df["k"] < 48).group_by("s")
+                      .agg(F.sum("v").alias("sv"),
+                           F.count("k").alias("c")).collect()))
+
+clean = TpuSparkSession(RapidsConf(BASE))
+want = q(clean)
+
+s = TpuSparkSession(RapidsConf({
+    **BASE, "spark.rapids.sql.tpu.faults.spec": "scan:oom@2"}))
+got = q(s)
+assert got == want, f"faulted scan diverged:\n{got[:3]}\n{want[:3]}"
+m = s.last_metrics
+assert m["retryCount"] > 0, m
+assert m["faultsInjected"] >= 1, m
+assert s.runtime.semaphore.held_depth() == 0
+print("scan fault smoke ok:", {k: m[k] for k in (
+    "retryCount", "faultsInjected", "scanBytesDecoded",
+    "scanDictColumns")})
 PY
 
 echo "== fault-injection smoke: unspill:oom@1 under a tiny budget must"

@@ -26,6 +26,11 @@ dispatch, no device work, no re-execution.  Five families:
   counters must match a full handle scan after in-flight spills drain; a
   mismatch means some tier transition skipped its counter update and the
   budget loop is steering on a stale number.
+* **Encoded corridor** — dictionary-encoded string columns never cross
+  the collection DeviceToHost unmaterialized (``ctx.encoded_d2h_leaks``,
+  recorded by DeviceToHostExec), and encoded pieces the spill catalog
+  holds on the host tier are structurally reconstructible (non-empty
+  dictionary, codes inside it) so unspill rebuilds the same column.
 
 The module imports no engine code at import time so `tools/rapidslint.py`
 and other host-only tooling can load it without pulling in jax; the
@@ -269,6 +274,24 @@ def check_adaptive_events(root, ctx) -> List[str]:
     return problems
 
 
+def check_encoded_corridor(runtime, ctx) -> List[str]:
+    """Encoded columns never cross the collection D2H unmaterialized, and
+    host-tier encoded spill pieces are structurally consistent."""
+    problems = []
+    leaks = getattr(ctx, "encoded_d2h_leaks", 0) if ctx is not None else 0
+    if leaks:
+        problems.append(
+            f"{leaks} collected host batch(es) carried dictionary-encoded "
+            "columns across DeviceToHost — collection must materialize "
+            "(only spill tier transitions keep the dictionary)")
+    catalog = getattr(runtime, "catalog", None) if runtime is not None \
+        else None
+    if catalog is not None and \
+            hasattr(catalog, "verify_encoded_host_batches"):
+        problems += list(catalog.verify_encoded_host_batches())
+    return problems
+
+
 def check_semaphore_balance(runtime) -> List[str]:
     """Post-query the task-wide hold depth must be zero."""
     sem = getattr(runtime, "semaphore", None)
@@ -290,6 +313,7 @@ def verify_plan(root, runtime=None, ctx=None) -> None:
     problems += check_mesh_sharding(root)
     if ctx is not None:
         problems += check_adaptive_events(root, ctx)
+    problems += check_encoded_corridor(runtime, ctx)
     if runtime is not None:
         problems += check_semaphore_balance(runtime)
         problems += check_catalog_accounting(runtime)

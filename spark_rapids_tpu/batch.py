@@ -467,7 +467,8 @@ def host_to_device(batch: HostBatch, capacity: Optional[int] = None,
     return out
 
 
-def device_to_host_many(batches: Sequence[ColumnBatch]) -> List[HostBatch]:
+def device_to_host_many(batches: Sequence[ColumnBatch],
+                        keep_dictionary: bool = False) -> List[HostBatch]:
     # ONE bulk device_get for all batches' buffers AND num_rows scalars:
     # jax prefetches every leaf with copy_to_host_async before blocking, so
     # the whole pytree rides a single sync + round trip.  Per-column gets
@@ -501,15 +502,21 @@ def device_to_host_many(batches: Sequence[ColumnBatch]) -> List[HostBatch]:
             validity = np.asarray(bufs[1])[:n]
             if f.dtype.is_string and len(bufs) == 4:
                 # dictionary-encoded: decode the (small) dictionary once,
-                # then fan the per-row codes out through it — D2H always
-                # returns plain values (dict columns never leave the
-                # scan->device corridor)
+                # then fan the per-row codes out through it.  Collection
+                # D2H always returns plain values; ``keep_dictionary``
+                # (spill tier transitions) keeps (codes, entries) so an
+                # encoded piece survives spill/unspill encoded.
                 d_off = np.asarray(bufs[2])
                 raw = np.asarray(bufs[0]).tobytes()
                 codes = np.asarray(bufs[3])[:n]
                 nd = int(codes.max()) + 1 if n else 0
                 entries = [raw[d_off[i]:d_off[i + 1]].decode(
                     "utf-8", errors="replace") for i in range(nd)]
+                if keep_dictionary:
+                    ents = np.array(entries or [""], dtype=object)
+                    out_cols.append(HostColumn(
+                        f.dtype, codes.astype(np.int64), validity, ents))
+                    continue
                 values = np.empty(n, dtype=object)
                 for i in range(n):
                     values[i] = entries[codes[i]] if validity[i] else ""
@@ -544,8 +551,9 @@ def device_to_host_many(batches: Sequence[ColumnBatch]) -> List[HostBatch]:
     return out
 
 
-def device_to_host(batch: ColumnBatch) -> HostBatch:
-    return device_to_host_many([batch])[0]
+def device_to_host(batch: ColumnBatch,
+                   keep_dictionary: bool = False) -> HostBatch:
+    return device_to_host_many([batch], keep_dictionary=keep_dictionary)[0]
 
 
 def host_batch_bytes(hb: HostBatch) -> int:

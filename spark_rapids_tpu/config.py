@@ -113,6 +113,17 @@ class RapidsConf:
             c.set(k, v)
         return c
 
+    def explicitly_set(self, key: str) -> bool:
+        """True when the user pinned ``key`` — an explicit session
+        setting or an environment override.  Adaptive controllers use
+        this to honor pinned values instead of tuning over them (e.g.
+        scan.readAhead.depth set explicitly disables the adaptive
+        read-ahead controller)."""
+        if key in self._settings:
+            return True
+        env_key = "SPARK_RAPIDS_TPU_" + key.replace(".", "_").upper()
+        return env_key in os.environ
+
     def is_operator_enabled(self, key: str, default: bool = True) -> bool:
         v = self.get(key)
         if v is None:
@@ -304,6 +315,36 @@ SCAN_READAHEAD_DEPTH = conf_int(
     "pipeline (bounded sliding window over the shared decode pool). "
     "Chunks are still yielded in deterministic file/chunk order.  "
     "<=1 decodes one chunk at a time (no read-ahead).")
+SCAN_READAHEAD_ADAPTIVE = conf_bool(
+    "spark.rapids.sql.tpu.scan.readAhead.adaptive.enabled", True,
+    "Close the read-ahead control loop: the v2 scan adjusts its in-flight "
+    "decode-task depth between chunk drains from its own blocked-drain "
+    "ratio and the decode pool's utilization gauge — deepening while the "
+    "consumer starves and the pool has headroom, shallowing when chunks "
+    "are always ready (less host memory pinned in decoded-but-unconsumed "
+    "chunks).  Clamped to [1, scan.readAhead.maxDepth].  Ignored (static "
+    "depth honored) when scan.readAhead.depth is set explicitly.")
+SCAN_READAHEAD_MAX_DEPTH = conf_int(
+    "spark.rapids.sql.tpu.scan.readAhead.maxDepth", 16,
+    "Upper clamp for the adaptive read-ahead controller "
+    "(scan.readAhead.adaptive.enabled) — at most this many decode tasks "
+    "in flight ahead of the scan consumer, bounding decoded-chunk host "
+    "memory no matter how starved the consumer looks.")
+SCAN_PAGE_CHUNK_MIN_BYTES = conf_bytes(
+    "spark.rapids.sql.tpu.scan.pageChunk.minBytes", 64 << 20,
+    "Sub-row-group decode granularity (v2 parquet): a row group whose "
+    "compressed footprint exceeds this is decoded as several column-slab "
+    "subtasks on the pool (the projected columns split into balanced "
+    "subsets) and reassembled column-wise on the consumer thread, so one "
+    "fat row group cannot serialize the decode pool.  <=0 disables "
+    "(always one task per row group).")
+SCAN_FILE_HANDLE_CACHE_SIZE = conf_int(
+    "spark.rapids.sql.tpu.scan.fileHandleCache.size", 8,
+    "Per-thread pyarrow file-handle cache capacity (io.decode_pool): "
+    "scan chunk tasks reuse the thread's open ParquetFile/ORC reader for "
+    "the same path instead of paying open()+footer-parse per row group; "
+    "least-recently-used handles past the bound are closed.  <=0 "
+    "disables caching (open per chunk, the v1 behavior).")
 SCAN_DICT_ENCODING_ENABLED = conf_bool(
     "spark.rapids.sql.tpu.scan.dictEncoding.enabled", True,
     "Keep parquet dictionary-encoded string columns encoded through "
@@ -428,6 +469,25 @@ SHUFFLE_COALESCE_MAX_BYTES = conf_bytes(
     "partition whose combined size exceeds this stays as per-batch "
     "pieces so the catalog can spill early pieces while later input "
     "batches still materialize.  <=0 coalesces unconditionally.")
+SHUFFLE_DICT_AWARE = conf_bool(
+    "spark.rapids.sql.tpu.exchange.dictAware.enabled", True,
+    "Dict-aware shuffle split (v2 split only): when input columns are "
+    "dictionary-encoded, the pid-sort permutes 4-byte codes and each "
+    "coalesced piece carries codes plus ONE merged dictionary instead of "
+    "materialized string bytes — the encoded corridor survives the "
+    "exchange, and shuffleEncodedBytesSaved records the bytes not moved. "
+    "Bit-identical results; piece sizing/AQE statistics still report "
+    "materialized bytes so plan decisions match encoded-off exactly.")
+JOIN_DICT_KEYS_ENABLED = conf_bool(
+    "spark.rapids.sql.tpu.join.dictKeys.enabled", True,
+    "Encoded equi-join string keys: when both sides of a hash join key "
+    "are dictionary-encoded, probe on int32 codes — directly when the "
+    "sides share one dictionary object, else after rendezvous-translating "
+    "the smaller side's codes into the larger dictionary's space via a "
+    "device entry-matching table (docs/io.md, encoded corridor v2).  "
+    "Divergent dictionaries whose entry-pair table would exceed ~4M "
+    "cells skip translation and hash entry content through the codes "
+    "instead (still encoded, no materialization).")
 PIPELINE_FUSE_TAIL = conf_bool(
     "spark.rapids.sql.tpu.pipeline.fuseTail.enabled", True,
     "Fuse the stage-break re-bucketing gather into the consuming (tail) "

@@ -11,13 +11,94 @@ MultiFileReaderThreadPool role, GpuMultiFileReader.scala).
 from __future__ import annotations
 
 import atexit
+import collections
 import concurrent.futures
+import os
 import threading
-from typing import Optional
+from typing import Callable, Optional
 
 _lock = threading.Lock()
 _pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
 _pool_size = 0
+
+# -- per-thread reader handle cache ------------------------------------------
+#
+# Every chunk task used to open its own pyarrow reader: the footer /
+# stripe-index parse repeats per row group of the same file, and the
+# comment in scan_v2 ("ParquetFile is not safe for concurrent reads")
+# only forbids CROSS-thread sharing.  Readers are therefore cached
+# per (thread, kind, path) — each pool worker reuses its own handle and
+# never shares it — with a bounded LRU that closes the evicted reader
+# (scan.fileHandleCache.size handles per thread; 0 disables).
+
+_tls = threading.local()
+_cache_hits = 0
+_cache_misses = 0
+
+
+def cached_reader(kind: str, path: str, factory: Callable[[], object],
+                  cache_size: int):
+    """Open-or-reuse a file reader for this thread.  ``kind`` keys reader
+    variants of the same path apart (e.g. parquet with/without
+    ``read_dictionary``)."""
+    global _cache_hits, _cache_misses
+    if cache_size <= 0:
+        return factory()
+    cache = getattr(_tls, "readers", None)
+    if cache is None:
+        cache = _tls.readers = collections.OrderedDict()
+    # mtime+size in the key: a rewritten file misses instead of serving a
+    # stale footer; the dead handle ages out of the LRU
+    try:
+        st = os.stat(path)
+        key = (kind, path, st.st_mtime_ns, st.st_size)
+    except OSError:
+        return factory()
+    r = cache.get(key)
+    if r is not None:
+        cache.move_to_end(key)
+        with _lock:
+            _cache_hits += 1
+        return r
+    r = factory()
+    cache[key] = r
+    with _lock:
+        _cache_misses += 1
+    while len(cache) > cache_size:
+        _k, old = cache.popitem(last=False)
+        close = getattr(old, "close", None)
+        if close is not None:
+            try:
+                close()
+            except OSError:
+                pass  # eviction is best-effort; the reader is unreferenced
+    return r
+
+
+def reader_cache_stats():
+    """(hits, misses) across all threads — tests and telemetry."""
+    with _lock:
+        return _cache_hits, _cache_misses
+
+
+def clear_reader_cache() -> None:
+    """Drop THIS thread's cached readers (closing them) and zero the
+    shared counters.  Tests call this for isolation; pool workers keep
+    their caches for the thread lifetime."""
+    global _cache_hits, _cache_misses
+    cache = getattr(_tls, "readers", None)
+    if cache:
+        for old in cache.values():
+            close = getattr(old, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except OSError:
+                    pass
+        cache.clear()
+    with _lock:
+        _cache_hits = 0
+        _cache_misses = 0
 
 
 def get_decode_pool(nthreads: int) -> concurrent.futures.ThreadPoolExecutor:

@@ -37,18 +37,33 @@ from typing import Callable, Iterable, List, Optional
 
 import numpy as np
 
+from spark_rapids_tpu import types as T
 from spark_rapids_tpu.batch import HostBatch, host_batch_bytes
 from spark_rapids_tpu.config import (
-    SCAN_DICT_ENCODING_ENABLED, SCAN_LATE_MAT_ENABLED, SCAN_READAHEAD_DEPTH,
+    SCAN_DICT_ENCODING_ENABLED, SCAN_FILE_HANDLE_CACHE_SIZE,
+    SCAN_LATE_MAT_ENABLED, SCAN_PAGE_CHUNK_MIN_BYTES,
+    SCAN_READAHEAD_ADAPTIVE, SCAN_READAHEAD_DEPTH, SCAN_READAHEAD_MAX_DEPTH,
     RapidsConf,
 )
 from spark_rapids_tpu.fault import inject
 from spark_rapids_tpu.io.arrow_convert import arrow_to_host_batch
-from spark_rapids_tpu.io.decode_pool import get_decode_pool
+from spark_rapids_tpu.io.decode_pool import (
+    cached_reader, decode_pool_utilization, get_decode_pool,
+)
 from spark_rapids_tpu.io.discovery import csv_options
 from spark_rapids_tpu.io.scan import CpuFileScanExec, _row_group_can_match
 from spark_rapids_tpu.obs import events as obs_events
+from spark_rapids_tpu.obs import timeseries as obs_ts
 from spark_rapids_tpu.plan.physical import ExecContext
+
+#: Decoded-and-ready chunks held beyond the one being consumed — chunk k
+#: on device, k+1 staged on host, k+2..k+1+depth decoding: the classic
+#: triple buffer, with the decode window as the third stage.
+_READY_BUF = 2
+
+#: Drains between adaptive read-ahead adjustments (smooths the
+#: blocked-fraction signal over a few chunks).
+_ADAPT_EVERY = 4
 
 
 @dataclasses.dataclass
@@ -106,6 +121,20 @@ def _chunk_survivors(descriptors, table) -> bool:
     return bool(mask.any()) if mask is not None else True
 
 
+def _dict_candidate(t) -> bool:
+    """String columns the encoded corridor can carry: plain strings (the
+    scan requests read_dictionary) and columns whose restored arrow
+    schema is ALREADY dictionary<string> (pyarrow round-trips the arrow
+    schema through parquet metadata, so a file written from encoded
+    arrays reads back dictionary-typed with no read_dictionary ask)."""
+    import pyarrow as pa
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        return True
+    return pa.types.is_dictionary(t) and (
+        pa.types.is_string(t.value_type) or
+        pa.types.is_large_string(t.value_type))
+
+
 class FileScanV2Exec(CpuFileScanExec):
     """Chunk-parallel scan with read-ahead, dictionary strings and late
     materialization; bit-parity with :class:`CpuFileScanExec`."""
@@ -113,6 +142,13 @@ class FileScanV2Exec(CpuFileScanExec):
     def __init__(self, node, conf: RapidsConf):
         super().__init__(node, conf)
         self._depth = max(1, SCAN_READAHEAD_DEPTH.get(conf))
+        # the adaptive controller owns the depth UNLESS the user pinned
+        # scan.readAhead.depth explicitly — static wins when set
+        self._adaptive = (SCAN_READAHEAD_ADAPTIVE.get(conf) and
+                          not conf.explicitly_set(SCAN_READAHEAD_DEPTH.key))
+        self._max_depth = max(self._depth, SCAN_READAHEAD_MAX_DEPTH.get(conf))
+        self._page_min_bytes = SCAN_PAGE_CHUNK_MIN_BYTES.get(conf)
+        self._handle_cache = max(0, SCAN_FILE_HANDLE_CACHE_SIZE.get(conf))
         self._dict_enabled = SCAN_DICT_ENCODING_ENABLED.get(conf)
         self._late_mat = SCAN_LATE_MAT_ENABLED.get(conf)
         self._device_consumer = False
@@ -146,29 +182,93 @@ class FileScanV2Exec(CpuFileScanExec):
         part_names = {f.name for f in part_fields}
         return [n for n in self.output_schema.names if n not in part_names]
 
-    def _chunk_tasks(self, files: List[str]
-                     ) -> Iterable[Callable[[], _ChunkResult]]:
-        """Lazily yield one decode task per chunk, in deterministic order
-        (file order, then chunk index) — the sliding window preserves it."""
+    def _parquet_file(self, path: str, read_dict: Optional[List[str]] = None):
+        import pyarrow.parquet as pq
+        kind = "pq" if not read_dict else "pq+dict:" + ",".join(read_dict)
+        if read_dict:
+            return cached_reader(
+                kind, path,
+                lambda: pq.ParquetFile(path, read_dictionary=read_dict),
+                self._handle_cache)
+        return cached_reader(kind, path, lambda: pq.ParquetFile(path),
+                             self._handle_cache)
+
+    def _orc_file(self, path: str):
+        import pyarrow.orc as orc
+        return cached_reader("orc", path, lambda: orc.ORCFile(path),
+                             self._handle_cache)
+
+    def _plan_column_slabs(self, meta, rg: int, columns: List[str]
+                           ) -> Optional[List[List[str]]]:
+        """Page-level chunk granularity: split an OVERSIZED parquet row
+        group into contiguous column slabs of >= scan.pageChunk.minBytes
+        compressed bytes each, decoded as parallel pool tasks and zipped
+        back column-wise by the consumer — one writer's giant row group
+        stops serializing the whole pipeline behind a single decode
+        thread.  Returns None (no split) for small row groups, single- or
+        zero-column projections, and pushed-predicate scans (slabs would
+        re-run the survival probe per slab)."""
+        if self._page_min_bytes <= 0 or self.descriptors or \
+                len(columns) < 2:
+            return None
+        rgm = meta.row_group(rg)
+        sizes = {}
+        for i in range(rgm.num_columns):
+            c = rgm.column(i)
+            name = c.path_in_schema.split(".")[0]
+            sizes[name] = sizes.get(name, 0) + c.total_compressed_size
+        total = sum(sizes.get(n, 0) for n in columns)
+        if total < 2 * self._page_min_bytes:
+            return None
+        n_slabs = min(len(columns), total // self._page_min_bytes)
+        target = total / n_slabs
+        slabs: List[List[str]] = []
+        cur: List[str] = []
+        acc = 0
+        for name in columns:
+            cur.append(name)
+            acc += sizes.get(name, 0)
+            if acc >= target and len(slabs) < n_slabs - 1:
+                slabs.append(cur)
+                cur, acc = [], 0
+        if cur:
+            slabs.append(cur)
+        return slabs if len(slabs) > 1 else None
+
+    def _chunk_tasks(self, files: List[str]):
+        """Lazily yield one decode task GROUP per chunk as ``(path,
+        [callables])``, in deterministic order (file order, then chunk
+        index) — the sliding window preserves it.  A group has one task
+        per column slab (len 1 for everything but oversized parquet row
+        groups); the consumer zips multi-slab results column-wise."""
         columns = self._file_columns()
         batch_rows = self.conf.max_readers_batch_size_rows
         for path in files:
             if self.fmt == "parquet":
-                import pyarrow.parquet as pq
-                n_rg = pq.ParquetFile(path).metadata.num_row_groups
-                for rg in range(n_rg):
-                    yield (lambda p=path, i=rg:
-                           self._decode_parquet_chunk(p, i, columns,
-                                                      batch_rows))
+                meta = self._parquet_file(path).metadata
+                for rg in range(meta.num_row_groups):
+                    slabs = self._plan_column_slabs(meta, rg, columns) \
+                        if columns else None
+                    if slabs is None:
+                        yield path, [
+                            lambda p=path, i=rg:
+                            self._decode_parquet_chunk(p, i, columns,
+                                                       batch_rows)]
+                    else:
+                        yield path, [
+                            lambda p=path, i=rg, s=slab:
+                            self._decode_parquet_slab(p, i, s, batch_rows)
+                            for slab in slabs]
             elif self.fmt == "orc":
-                import pyarrow.orc as orc
-                n_stripes = orc.ORCFile(path).nstripes
+                n_stripes = self._orc_file(path).nstripes
                 for st in range(n_stripes):
-                    yield (lambda p=path, i=st:
-                           self._decode_orc_chunk(p, i, columns, batch_rows))
+                    yield path, [
+                        lambda p=path, i=st:
+                        self._decode_orc_chunk(p, i, columns, batch_rows)]
             elif self.fmt == "csv":
-                yield (lambda p=path:
-                       self._decode_csv_chunk(p, columns, batch_rows))
+                yield path, [
+                    lambda p=path:
+                    self._decode_csv_chunk(p, columns, batch_rows)]
             else:
                 raise ValueError(self.fmt)
 
@@ -193,18 +293,17 @@ class FileScanV2Exec(CpuFileScanExec):
         import pyarrow.parquet as pq
         res = _ChunkResult([], rg_total=1, label=f"parquet:{rg}",
                            t0=time.monotonic_ns())
-        # each task opens its own reader: ParquetFile is not safe for
-        # concurrent reads from multiple pool threads
-        f = pq.ParquetFile(path)
+        # readers are per-THREAD (decode_pool.cached_reader): ParquetFile
+        # is not safe for concurrent reads from multiple pool threads,
+        # but one worker reusing its own handle across row groups is
+        f = self._parquet_file(path)
         file_schema = f.schema_arrow
         read_dict: List[str] = []
         if self._use_dict():
-            read_dict = [
-                n for n in file_schema.names
-                if (pa.types.is_string(file_schema.field(n).type) or
-                    pa.types.is_large_string(file_schema.field(n).type))]
+            read_dict = [n for n in file_schema.names
+                         if _dict_candidate(file_schema.field(n).type)]
             if read_dict:
-                f = pq.ParquetFile(path, read_dictionary=read_dict)
+                f = self._parquet_file(path, read_dict)
         meta = f.metadata
         col_index = {meta.schema.column(i).name: i
                      for i in range(meta.num_columns)}
@@ -248,12 +347,72 @@ class FileScanV2Exec(CpuFileScanExec):
         res.decode_ns = res.t1 - res.t0
         return res
 
+    def _decode_parquet_slab(self, path: str, rg: int, slab: List[str],
+                             batch_rows: int) -> _ChunkResult:
+        """Decode ONE column slab of a row group (page-level granularity;
+        no predicate pushdown here — _plan_column_slabs guards).  Raw
+        result: no partition columns, no byte accounting — the consumer
+        merges slabs and runs _finish_chunk once."""
+        import pyarrow as pa
+        res = _ChunkResult([], label=f"parquet:{rg}:{slab[0]}",
+                           t0=time.monotonic_ns())
+        f = self._parquet_file(path)
+        file_schema = f.schema_arrow
+        read_dict: List[str] = []
+        if self._use_dict():
+            read_dict = [n for n in slab
+                         if n in file_schema.names and
+                         _dict_candidate(file_schema.field(n).type)]
+            if read_dict:
+                f = self._parquet_file(path, read_dict)
+        tb = f.read_row_group(rg, columns=slab)
+        hb = arrow_to_host_batch(tb, keep_dictionary=bool(read_dict))
+        res.batches = [hb.slice(j, min(batch_rows, hb.num_rows - j))
+                       for j in range(0, hb.num_rows, batch_rows)]
+        res.t1 = time.monotonic_ns()
+        res.decode_ns = res.t1 - res.t0
+        return res
+
+    def _merge_slab_results(self, path: str,
+                            results: List[_ChunkResult]) -> _ChunkResult:
+        """Zip column-slab results back into one whole-row chunk.  Slabs
+        cover disjoint contiguous column ranges of the SAME rows with the
+        same batch_rows splits, so batch j of every slab aligns."""
+        res = _ChunkResult([], rg_total=1, rg_read=1,
+                           label=results[0].label.rsplit(":", 1)[0],
+                           t0=min(r.t0 for r in results),
+                           t1=max(r.t1 for r in results))
+        res.decode_ns = sum(r.decode_ns for r in results)
+        merged = []
+        for parts in zip(*(r.batches for r in results)):
+            fields = [f for hb in parts for f in hb.schema.fields]
+            cols = [c for hb in parts for c in hb.columns]
+            merged.append(HostBatch(T.Schema(fields), cols))
+        self._finish_chunk(path, merged, res)
+        return res
+
+    def _dict_encode_table(self, tb):
+        """Host-side dictionary encoding for formats without a native
+        dictionary read path (ORC stripes, CSV): string columns re-encode
+        to (codes, entries) before staging, so H2D still moves 4-byte
+        codes plus the dictionary once.  Returns (table, encoded_any)."""
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        if not self._use_dict():
+            return tb, False
+        encoded = False
+        for i, f in enumerate(tb.schema):
+            if pa.types.is_string(f.type) or pa.types.is_large_string(f.type):
+                tb = tb.set_column(i, f.name,
+                                   pc.dictionary_encode(tb.column(i)))
+                encoded = True
+        return tb, encoded
+
     def _decode_orc_chunk(self, path: str, stripe: int, columns: List[str],
                           batch_rows: int) -> _ChunkResult:
-        import pyarrow.orc as orc
         res = _ChunkResult([], rg_total=1, label=f"orc:{stripe}",
                            t0=time.monotonic_ns())
-        f = orc.ORCFile(path)
+        f = self._orc_file(path)
         avail = set(f.schema.names)
         pred_cols = sorted({name for name, _op, _v in self.descriptors
                             if name in avail})
@@ -271,8 +430,9 @@ class FileScanV2Exec(CpuFileScanExec):
                 res.decode_ns = res.t1 - res.t0
                 return res  # v1-style min/max stripe skip
         res.rg_read = 1
-        hb = arrow_to_host_batch(f.read_stripe(stripe,
-                                               columns=columns or None))
+        tb, enc = self._dict_encode_table(
+            f.read_stripe(stripe, columns=columns or None))
+        hb = arrow_to_host_batch(tb, keep_dictionary=enc)
         batches = [hb.slice(j, min(batch_rows, hb.num_rows - j))
                    for j in range(0, hb.num_rows, batch_rows)]
         self._finish_chunk(path, batches, res)
@@ -313,7 +473,8 @@ class FileScanV2Exec(CpuFileScanExec):
         tb = pacsv.read_csv(path, read_options=read_opts,
                             parse_options=parse_opts,
                             convert_options=conv_opts)
-        hb = arrow_to_host_batch(tb)
+        tb, enc = self._dict_encode_table(tb)
+        hb = arrow_to_host_batch(tb, keep_dictionary=enc)
         batches = [hb.slice(j, min(batch_rows, hb.num_rows - j))
                    for j in range(0, hb.num_rows, batch_rows)] \
             if hb.num_rows else []
@@ -335,20 +496,31 @@ class FileScanV2Exec(CpuFileScanExec):
         m_bytes = ctx.metric(self.op_id, "scanBytesDecoded")
         m_dict = ctx.metric(self.op_id, "scanDictColumns")
         m_skipped = ctx.metric(self.op_id, "scanChunksSkipped")
+        m_depth = ctx.metric(self.op_id, "readaheadDepthEffective")
         rg_read = ctx.metric(self.op_id, "rowGroupsRead")
         rg_total = ctx.metric(self.op_id, "rowGroupsTotal")
-        depth = self._depth
+        adaptive = self._adaptive
+        max_depth = self._max_depth
 
         def gen(files: List[str]):
+            # pending: (path, [futures]) decode window, submission order.
+            # ready: decoded chunks harvested off the window head but not
+            # yet yielded — the host-side stage of the triple buffer.
             pending: collections.deque = collections.deque()
+            ready: collections.deque = collections.deque()
             stats = {"decode": 0, "bytes": 0, "skipped": 0, "dict": 0,
-                     "rg_read": 0, "rg_total": 0, "blocked": 0}
+                     "rg_read": 0, "rg_total": 0, "blocked": 0,
+                     "drains": 0, "win_blocked": 0,
+                     "win_t0": time.monotonic_ns(),
+                     "depth": self._depth, "depth_max": self._depth}
 
-            def drain_one() -> _ChunkResult:
-                fu = pending.popleft()
-                w0 = time.monotonic_ns()
-                res = fu.result()
-                stats["blocked"] += time.monotonic_ns() - w0
+            def finish_entry(entry, blocked_ns: int) -> _ChunkResult:
+                _path, futs = entry  # every future completed by now
+                rs = [fu.result() for fu in futs]
+                res = rs[0] if len(rs) == 1 else \
+                    self._merge_slab_results(_path, rs)
+                stats["blocked"] += blocked_ns
+                stats["win_blocked"] += blocked_ns
                 stats["decode"] += res.decode_ns
                 stats["bytes"] += res.bytes_decoded
                 stats["skipped"] += 1 if res.skipped else 0
@@ -361,17 +533,65 @@ class FileScanV2Exec(CpuFileScanExec):
                     skipped=res.skipped)
                 return res
 
+            def adapt() -> None:
+                # telemetry-driven read-ahead: raise the depth while the
+                # consumer blocks on decode AND the pool has headroom;
+                # shed it when chunks pile up decoded-but-unconsumed
+                stats["drains"] += 1
+                if not adaptive or stats["drains"] % _ADAPT_EVERY:
+                    return
+                now = time.monotonic_ns()
+                wall = max(now - stats["win_t0"], 1)
+                blocked_frac = stats["win_blocked"] / wall
+                d = stats["depth"]
+                if blocked_frac > 0.05 and decode_pool_utilization() < 1.0:
+                    d = min(d + 1, max_depth)
+                elif blocked_frac < 0.005 and len(ready) >= _READY_BUF:
+                    d = max(d - 1, 1)
+                if d != stats["depth"]:
+                    stats["depth"] = d
+                    stats["depth_max"] = max(stats["depth_max"], d)
+                obs_ts.record_value("io.scan.readahead_depth", float(d))
+                stats["win_blocked"] = 0
+                stats["win_t0"] = now
+
+            def drain_blocking() -> _ChunkResult:
+                entry = pending.popleft()
+                w0 = time.monotonic_ns()
+                for fu in entry[1]:
+                    fu.result()
+                res = finish_entry(entry, time.monotonic_ns() - w0)
+                adapt()
+                return res
+
+            def harvest() -> None:
+                # move COMPLETED head entries out of the decode window so
+                # the submit loop starts the next decode immediately
+                # instead of counting finished chunks against the depth
+                while pending and len(ready) < _READY_BUF and \
+                        all(fu.done() for fu in pending[0][1]):
+                    ready.append(finish_entry(pending.popleft(), 0))
+
             def results():
-                for task in self._chunk_tasks(files):
+                for path, tasks in self._chunk_tasks(files):
                     # fire on the consumer thread: deterministic per-query
                     # numbering AND the active query's scoped registry
                     # (pool workers carry no obs scope)
                     inject.maybe_fire("scan")
-                    pending.append(pool.submit(task))
-                    while len(pending) >= depth:
-                        yield drain_one()
-                while pending:
-                    yield drain_one()
+                    pending.append((path, [pool.submit(t) for t in tasks]))
+                    harvest()
+                    while len(pending) >= stats["depth"]:
+                        if ready:
+                            yield ready.popleft()
+                        else:
+                            yield drain_blocking()
+                        harvest()
+                while pending or ready:
+                    if ready:
+                        yield ready.popleft()
+                    else:
+                        yield drain_blocking()
+                    harvest()
 
             try:
                 for res in results():
@@ -379,14 +599,19 @@ class FileScanV2Exec(CpuFileScanExec):
                         if hb.num_rows:
                             yield hb
             finally:
-                for fu in pending:
-                    fu.cancel()
+                for _path, futs in pending:
+                    for fu in futs:
+                        fu.cancel()
                 pending.clear()
+                ready.clear()
                 m_decode.add(stats["decode"])
                 m_overlap.add(max(0, stats["decode"] - stats["blocked"]))
                 m_bytes.add(stats["bytes"])
                 m_dict.add(stats["dict"])
                 m_skipped.add(stats["skipped"])
+                # max, not sum: each partition generator reports the
+                # deepest read-ahead it actually ran
+                m_depth.value = max(m_depth.value, stats["depth_max"])
                 rg_read.add(stats["rg_read"])
                 rg_total.add(stats["rg_total"])
 

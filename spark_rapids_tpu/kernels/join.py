@@ -46,24 +46,37 @@ def _mix32(h, w):
     return h * jnp.uint32(5) + jnp.uint32(0xE6546B64)
 
 
-def _key_hash2(vals: List[DevVal]):
+def _key_hash2(vals: List[DevVal], code_over: Optional[list] = None):
     """(h1 u32[cap], h2 u32[cap], all_valid bool[cap]) over the key columns.
 
     Two independent 32-bit hashes (native on TPU — no u64 emulation).  The
     build side sorts by (h1, h2); probes range-scan on h1 and verify
     exactly.  Rows with any NULL key get sentinel ~0 hashes (sort last,
-    never matched — SQL null-key semantics)."""
+    never matched — SQL null-key semantics).
+
+    ``code_over`` (encoded corridor v2, docs/io.md): per-column aligned
+    canonical code arrays from :func:`align_dict_codes`.  A column with an
+    override hashes ONE int32 word per row instead of its string content —
+    valid because aligned codes are equal exactly when contents are equal.
+    Hash VALUES differ from content hashing, but the join's pair order
+    does not depend on them (equal keys hash equal either way, and
+    equal-hash build rows keep their stable original order), so results
+    stay bit-identical."""
     cap = int(vals[0].validity.shape[0])
     h1 = jnp.full(cap, jnp.uint32(0x12345678))
     h2 = jnp.full(cap, jnp.uint32(0x9E3779B9))
     ok = jnp.ones(cap, dtype=jnp.bool_)
-    for v in vals:
+    for ki, v in enumerate(vals):
         ok = ok & v.validity
-        if v.dtype.is_string:
-            from spark_rapids_tpu.exprs.strings import string_hash2
+        over = code_over[ki] if code_over is not None else None
+        if over is not None:
+            words = [over.astype(jnp.uint32)]
+        elif v.dtype.is_string:
+            from spark_rapids_tpu.exprs.strings import (
+                string_hash2, string_lengths,
+            )
             s1, s2 = string_hash2(v)
-            words = [s1, s2,
-                     (v.offsets[1:] - v.offsets[:-1]).astype(jnp.uint32)]
+            words = [s1, s2, string_lengths(v).astype(jnp.uint32)]
         else:
             from spark_rapids_tpu.kernels.sortkeys import \
                 _encode_fixed_words
@@ -75,18 +88,30 @@ def _key_hash2(vals: List[DevVal]):
     return (jnp.where(ok, h1, sentinel), jnp.where(ok, h2, sentinel), ok)
 
 
-def _exact_eq(a_vals: List[DevVal], a_idx, b_vals: List[DevVal], b_idx):
-    """Exact key equality for gathered index pairs (both sides valid)."""
+def _exact_eq(a_vals: List[DevVal], a_idx, b_vals: List[DevVal], b_idx,
+              code_over: Optional[list] = None):
+    """Exact key equality for gathered index pairs (both sides valid).
+
+    ``code_over``: per-column (a_codes, b_codes) pairs of ALIGNED
+    canonical codes — equality is then one int32 compare per pair, and it
+    is EXACT (no residual hash-collision risk), since aligned codes are
+    equal iff entry contents are equal."""
     eq = jnp.ones(a_idx.shape, dtype=jnp.bool_)
-    for va, vb in zip(a_vals, b_vals):
+    for ki, (va, vb) in enumerate(zip(a_vals, b_vals)):
         eq = eq & va.validity[a_idx] & vb.validity[b_idx]
-        if va.dtype.is_string:
-            from spark_rapids_tpu.exprs.strings import string_hash2
+        over = code_over[ki] if code_over is not None else None
+        if over is not None:
+            oa, ob = over
+            eq = eq & (oa[a_idx] == ob[b_idx])
+        elif va.dtype.is_string:
+            from spark_rapids_tpu.exprs.strings import (
+                string_hash2, string_lengths,
+            )
             from spark_rapids_tpu.kernels.sortkeys import (
                 DEFAULT_STRING_PREFIX_BYTES, string_prefix_words,
             )
-            la = (va.offsets[1:] - va.offsets[:-1])[a_idx]
-            lb = (vb.offsets[1:] - vb.offsets[:-1])[b_idx]
+            la = string_lengths(va)[a_idx]
+            lb = string_lengths(vb)[b_idx]
             a1, a2 = string_hash2(va)
             b1, b2 = string_hash2(vb)
             eq = eq & (la == lb) & (a1[a_idx] == b1[b_idx]) & \
@@ -106,6 +131,100 @@ def _exact_eq(a_vals: List[DevVal], a_idx, b_vals: List[DevVal], b_idx):
                               _encode_fixed_words(vb)):
                 eq = eq & (wa[a_idx] == wb[b_idx])
     return eq
+
+
+#: Entry-pair table guard for :func:`align_dict_codes`: alignment builds
+#: an [nd_a, nd_b] boolean content-equality grid; past this many cells
+#: the memory/FLOP cost beats rehashing content through the codes, so
+#: the caller falls back to content mode (still encoded, still exact
+#: under the same residual-collision policy as plain string joins).
+DICT_ALIGN_MAX_CELLS = 1 << 22
+
+
+def _entry_eq_matrix(ent_a: DevVal, ent_b: DevVal):
+    """[nd_a, nd_b] bool: dictionary entry contents equal.  Same equality
+    policy as :func:`_exact_eq`'s string branch — dual 32-bit hashes +
+    length + exact 64-byte prefix — applied entry-vs-entry."""
+    from spark_rapids_tpu.exprs.strings import string_hash2
+    from spark_rapids_tpu.kernels.sortkeys import (
+        DEFAULT_STRING_PREFIX_BYTES, string_prefix_words,
+    )
+    a1, a2 = string_hash2(ent_a)
+    b1, b2 = string_hash2(ent_b)
+    la = (ent_a.offsets[1:] - ent_a.offsets[:-1]).astype(jnp.int32)
+    lb = (ent_b.offsets[1:] - ent_b.offsets[:-1]).astype(jnp.int32)
+    eq = (a1[:, None] == b1[None, :]) & (a2[:, None] == b2[None, :]) & \
+        (la[:, None] == lb[None, :])
+    for wa, wb in zip(
+            string_prefix_words(ent_a, DEFAULT_STRING_PREFIX_BYTES),
+            string_prefix_words(ent_b, DEFAULT_STRING_PREFIX_BYTES)):
+        eq = eq & (wa[:, None] == wb[None, :])
+    return eq
+
+
+def _entries_of(v: DevVal) -> DevVal:
+    nd = int(v.offsets.shape[0]) - 1
+    return DevVal(v.dtype, v.data, jnp.ones(nd, dtype=jnp.bool_), v.offsets)
+
+
+def align_dict_codes(lv: DevVal, rv: DevVal,
+                     max_cells: int = DICT_ALIGN_MAX_CELLS):
+    """Rendezvous alignment of two dictionary-encoded key columns into one
+    canonical code space, so the join can hash/compare int32 codes.
+
+    Returns ``(l_codes, r_codes)`` int32[cap] arrays where equal values
+    mean equal string contents, or ``None`` when either side is not
+    encoded or the entry-pair table would exceed ``max_cells``.
+
+    Both sides canonicalize against the LARGER dictionary (the "dst"):
+    every entry maps to the FIRST content-equal dst entry (argmax over the
+    content-equality grid), which also collapses duplicate entries —
+    shuffle-merged dictionaries legitimately repeat entries across their
+    input pieces, so raw codes are NOT comparable even within one
+    dictionary.  A src entry absent from dst maps to the distinct
+    negative code ``-1 - entry`` (never equal to any canonical dst code,
+    and rows sharing that src entry cannot match any dst row — its
+    content does not exist on the other side).  Shared-dictionary sides
+    (``data``/``offsets`` the same objects — the scan corridor's common
+    case) skip the cross table and self-canonicalize once.  Invalid rows
+    pass through masked by validity downstream, as everywhere else."""
+    if lv.codes is None or rv.codes is None:
+        return None
+    nd_l = int(lv.offsets.shape[0]) - 1
+    nd_r = int(rv.offsets.shape[0]) - 1
+    if nd_l == 0 or nd_r == 0:
+        return None
+
+    def row_codes(v, mapping, nd):
+        codes_c = jnp.clip(v.codes, 0, max(nd - 1, 0))
+        return mapping[codes_c].astype(jnp.int32)
+
+    shared = lv.data is rv.data and lv.offsets is rv.offsets
+    if shared:
+        if nd_l * nd_l > max_cells:
+            return None
+        ent = _entries_of(lv)
+        canon = jnp.argmax(_entry_eq_matrix(ent, ent),
+                           axis=1).astype(jnp.int32)
+        return row_codes(lv, canon, nd_l), row_codes(rv, canon, nd_r)
+    if nd_l * nd_r + max(nd_l, nd_r) ** 2 > max_cells:
+        return None
+    # translate the smaller dictionary into the larger's code space
+    src, dst, src_is_left = (lv, rv, True) if nd_l <= nd_r else \
+        (rv, lv, False)
+    nd_src, nd_dst = (nd_l, nd_r) if src_is_left else (nd_r, nd_l)
+    ent_src, ent_dst = _entries_of(src), _entries_of(dst)
+    canon_dst = jnp.argmax(_entry_eq_matrix(ent_dst, ent_dst),
+                           axis=1).astype(jnp.int32)
+    cross = _entry_eq_matrix(ent_src, ent_dst)
+    found = jnp.any(cross, axis=1)
+    # argmax picks the FIRST content-equal dst entry — already canonical
+    mapped = jnp.where(found, jnp.argmax(cross, axis=1).astype(jnp.int32),
+                       -1 - jnp.arange(nd_src, dtype=jnp.int32))
+    src_codes = row_codes(src, mapped, nd_src)
+    dst_codes = row_codes(dst, canon_dst, nd_dst)
+    return (src_codes, dst_codes) if src_is_left else \
+        (dst_codes, src_codes)
 
 
 @dataclasses.dataclass
@@ -152,8 +271,21 @@ def join_pairs(left_keys: List[DevVal], left_num_rows,
     l_live = jnp.arange(l_cap, dtype=jnp.int32) < left_num_rows
     r_live = jnp.arange(r_cap, dtype=jnp.int32) < right_num_rows
 
-    l_h1, l_h2, l_ok = _key_hash2(left_keys)
-    r_h1, r_h2, r_ok = _key_hash2(right_keys)
+    # Encoded corridor v2: when both sides of a key column arrive
+    # dictionary-encoded, align their codes once (eager — the decision
+    # depends on host-known dictionary shapes) and hash/compare int32
+    # codes instead of string content.  Per column: override on BOTH
+    # sides or neither, so the hashes stay symmetric.
+    l_over: List[Optional[jnp.ndarray]] = []
+    r_over: List[Optional[jnp.ndarray]] = []
+    for lv, rv in zip(left_keys, right_keys):
+        pair = align_dict_codes(lv, rv)
+        l_over.append(None if pair is None else pair[0])
+        r_over.append(None if pair is None else pair[1])
+    any_over = any(o is not None for o in l_over)
+
+    l_h1, l_h2, l_ok = _key_hash2(left_keys, l_over if any_over else None)
+    r_h1, r_h2, r_ok = _key_hash2(right_keys, r_over if any_over else None)
     sentinel = ~jnp.uint32(0)
     r_h1 = jnp.where(r_live & r_ok, r_h1, sentinel)
     perm, r_sorted = _build_sort_jit(r_h1, r_h2)
@@ -167,8 +299,14 @@ def join_pairs(left_keys: List[DevVal], left_num_rows,
     if pair_cap_hint is not None:
         pair_cap = max(pair_cap, pair_cap_hint)
 
+    # aligned codes ride into phase 2 as bare arrays (a None column is a
+    # valid empty pytree) — NEVER wrapped in DevVals, where a stray
+    # materialization would clip the -1-i sentinels into entry 0
+    code_pairs = [None if a is None else (a, b)
+                  for a, b in zip(l_over, r_over)] if any_over else None
+
     @jax.jit
-    def phase2(lo, counts, perm, l_keys, r_keys, total):
+    def phase2(lo, counts, perm, l_keys, r_keys, code_pairs, total):
         cum = jnp.cumsum(counts)
         starts = cum - counts
         k = jnp.arange(pair_cap, dtype=jnp.int32)
@@ -178,7 +316,8 @@ def join_pairs(left_keys: List[DevVal], left_num_rows,
         build_pos = jnp.clip(lo[probe_row] + ordinal, 0, r_cap - 1)
         build_row = perm[build_pos]
         in_range = k < total
-        match = in_range & _exact_eq(l_keys, probe_row, r_keys, build_row)
+        match = in_range & _exact_eq(l_keys, probe_row, r_keys, build_row,
+                                     code_pairs)
         # compact matches to the front
         order = jnp.argsort(jnp.where(match, 0, 1), stable=True)
         n_pairs = jnp.sum(match).astype(jnp.int32)
@@ -193,15 +332,27 @@ def join_pairs(left_keys: List[DevVal], left_num_rows,
         return l_idx.astype(jnp.int32), r_idx.astype(jnp.int32), n_pairs, \
             l_counts, r_matched
 
-    return phase2(lo, counts, perm, left_keys, right_keys, total)
+    return phase2(lo, counts, perm, left_keys, right_keys, code_pairs,
+                  total)
 
 
 def _string_byte_caps(batch: ColumnBatch, indices, live) -> List[int]:
-    """Host-sync sizing of output byte capacities for string columns."""
+    """Host-sync sizing of output byte capacities for string columns.
+
+    Encoded columns size at their MATERIALIZED per-row lengths (entry
+    lengths gathered through clipped codes, NULL rows zero) — the output
+    gather materializes, and these caps must match encoded-off bit for
+    bit."""
     caps = []
     for c in batch.columns:
         if c.is_string:
-            lens = (c.offsets[1:] - c.offsets[:-1]).astype(jnp.int64)
+            if c.codes is not None:
+                nd = int(c.offsets.shape[0]) - 1
+                ent_lens = (c.offsets[1:] - c.offsets[:-1]).astype(jnp.int64)
+                codes_c = jnp.clip(c.codes, 0, max(nd - 1, 0))
+                lens = jnp.where(c.validity, ent_lens[codes_c], 0)
+            else:
+                lens = (c.offsets[1:] - c.offsets[:-1]).astype(jnp.int64)
             total = jnp.sum(jnp.where(live, lens[jnp.clip(
                 indices, 0, batch.capacity - 1)], 0))
             caps.append(round_up_capacity(int(jax.device_get(total)),

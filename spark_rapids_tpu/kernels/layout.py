@@ -59,15 +59,23 @@ def _gather_string_column(col: DeviceColumn, indices, live, out_cap: int,
 
 def gather_rows(batch: ColumnBatch, indices, num_rows,
                 out_capacity: Optional[int] = None,
-                out_byte_caps: Optional[Sequence[int]] = None) -> ColumnBatch:
+                out_byte_caps: Optional[Sequence[int]] = None,
+                keep_encoded: bool = False) -> ColumnBatch:
     """New batch whose row r is ``batch`` row ``indices[r]`` for r < num_rows.
 
     ``indices`` must be int32[out_capacity] (entries past ``num_rows`` are
     ignored).  ``out_byte_caps`` optionally gives the static byte capacity per
     string column (defaults to the input column's byte capacity — valid
     whenever the gather cannot grow total bytes, e.g. permutations/filters).
+
+    ``keep_encoded`` keeps dictionary-encoded columns encoded: the gather
+    permutes the 4-byte codes and shares the input's dictionary buffers
+    unchanged.  Only valid when the gather cannot grow the materialized
+    total (permutations/filters — exactly when the default byte caps are
+    valid), since ``mat_byte_cap`` is carried through as-is.
     """
-    batch = ensure_row_layout(batch)
+    if not keep_encoded:
+        batch = ensure_row_layout(batch)
     out_cap = out_capacity if out_capacity is not None else batch.capacity
     live = jnp.arange(out_cap, dtype=jnp.int32) < num_rows
     indices = jnp.clip(indices.astype(jnp.int32), 0, batch.capacity - 1)
@@ -75,7 +83,14 @@ def gather_rows(batch: ColumnBatch, indices, num_rows,
     cols = []
     str_i = 0
     for col in batch.columns:
-        if col.is_varlen:
+        if col.codes is not None:
+            if out_byte_caps is not None:
+                str_i += 1  # slot reserved; encoded keeps its mat bucket
+            codes = jnp.where(live, col.codes[indices], 0)
+            validity = jnp.where(live, col.validity[indices], False)
+            cols.append(DeviceColumn(col.dtype, col.data, validity,
+                                     col.offsets, codes, col.mat_byte_cap))
+        elif col.is_varlen:
             bcap = (out_byte_caps[str_i] if out_byte_caps is not None
                     else int(col.data.shape[0]))
             str_i += 1
@@ -298,8 +313,8 @@ _CONCAT_KWAY_JIT = None
 
 def gather_segments_kway(batches: Sequence[ColumnBatch], starts, counts,
                          out_capacity: int,
-                         out_byte_caps: Optional[Sequence[int]] = None
-                         ) -> ColumnBatch:
+                         out_byte_caps: Optional[Sequence[int]] = None,
+                         keep_encoded: bool = False) -> ColumnBatch:
     """Gather one contiguous row segment per input batch into ONE packed
     output batch: input j contributes rows ``[starts[j], starts[j]+counts[j])``
     at output row offset ``sum(counts[:j])``.
@@ -321,9 +336,24 @@ def gather_segments_kway(batches: Sequence[ColumnBatch], starts, counts,
     ``offsets[start] .. offsets[start+count]`` covers exactly the
     segment's live bytes (offsets are constant past ``num_rows`` by
     construction; see concat_kway's live-bytes note).
+
+    ``keep_encoded`` (dict-aware shuffle, docs/shuffle.md): when every
+    input part of a string column is dictionary-encoded, the output stays
+    encoded — codes are scattered with a per-input entry-base shift and
+    the input dictionaries are packed back-to-back into one merged
+    dictionary (entry bases are static: the cumsum of input dictionary
+    capacities; byte bases are traced: the cumsum of live dictionary
+    bytes, matching one dynamic scatter cursor per input exactly like
+    concat_kway's byte packing).  The column's ``out_byte_caps`` slot
+    then carries the OUTPUT ``mat_byte_cap`` (the materialized bucket a
+    later :func:`dict_decode_column` needs), not a data-buffer capacity —
+    the merged dictionary's capacity is the static sum of the input
+    dictionary capacities.  Columns with any plain part fall back to
+    materializing the encoded parts first.
     """
     assert batches
-    batches = [ensure_row_layout(b) for b in batches]
+    if not keep_encoded:
+        batches = [ensure_row_layout(b) for b in batches]
     schema = batches[0].schema
     for b in batches[1:]:
         assert b.schema == schema, f"{b.schema} != {schema}"
@@ -350,9 +380,50 @@ def gather_segments_kway(batches: Sequence[ColumnBatch], starts, counts,
     str_i = 0
     for ci, f in enumerate(schema.fields):
         parts = [b.columns[ci] for b in batches]
+        if keep_encoded and any(c.codes is not None for c in parts) \
+                and not all(c.codes is not None for c in parts):
+            # mixed encoded/plain parts: no shared dictionary space exists,
+            # so materialize the encoded ones and take the plain path
+            parts = [dict_decode_column(c) if c.codes is not None else c
+                     for c in parts]
         validity = scatter_segments(jnp.zeros(out_capacity, dtype=jnp.bool_),
                                     [c.validity for c in parts])
-        if parts[0].is_varlen:
+        if keep_encoded and all(c.codes is not None for c in parts):
+            mat_cap = (out_byte_caps[str_i] if out_byte_caps is not None
+                       else sum((c.mat_byte_cap or int(c.data.shape[0]))
+                                for c in parts))
+            str_i += 1
+            shifted_codes = []
+            ent_lens_parts = []
+            entry_base = 0  # static: dictionary capacities are shapes
+            for c in parts:
+                shifted_codes.append(c.codes + entry_base)
+                ent_lens_parts.append(
+                    (c.offsets[1:] - c.offsets[:-1]).astype(jnp.int32))
+                entry_base += int(c.offsets.shape[0]) - 1
+            codes = scatter_segments(
+                jnp.zeros(out_capacity, dtype=jnp.int32), shifted_codes)
+            # merged dictionary: entry lens concatenate at static bases, so
+            # one cumsum yields offsets whose per-input byte base equals the
+            # dynamic packing cursor below (padded entries have zero lens)
+            merged_offsets = jnp.concatenate([
+                jnp.zeros(1, dtype=jnp.int32),
+                jnp.cumsum(jnp.concatenate(ent_lens_parts)).astype(jnp.int32),
+            ])
+            dcap = sum(int(c.data.shape[0]) for c in parts)
+            data = jnp.zeros(dcap, dtype=parts[0].data.dtype)
+            byte_off = jnp.asarray(0, jnp.int32)
+            for c in parts:
+                nbytes_j = c.offsets[int(c.offsets.shape[0]) - 1]
+                biota = jnp.arange(int(c.data.shape[0]), dtype=jnp.int32)
+                tgt = jnp.where(biota < nbytes_j, byte_off + biota,
+                                dcap + biota)
+                data = data.at[tgt].set(c.data, mode="drop",
+                                        unique_indices=True)
+                byte_off = byte_off + nbytes_j
+            cols.append(DeviceColumn(f.dtype, data, validity, merged_offsets,
+                                     codes, mat_cap))
+        elif parts[0].is_varlen:
             bcap = (out_byte_caps[str_i] if out_byte_caps is not None
                     else sum(int(c.data.shape[0]) for c in parts))
             str_i += 1
@@ -384,16 +455,17 @@ def gather_segments_kway(batches: Sequence[ColumnBatch], starts, counts,
 
 
 def _gather_segments_kway_tuple(batches, starts, counts, out_capacity,
-                                out_byte_caps):
+                                out_byte_caps, keep_encoded=False):
     return gather_segments_kway(
         list(batches), list(starts), list(counts), out_capacity,
-        list(out_byte_caps) if out_byte_caps else None)
+        list(out_byte_caps) if out_byte_caps else None,
+        keep_encoded=keep_encoded)
 
 
 def gather_segments_kway_run(batches: Sequence[ColumnBatch], starts, counts,
                              out_capacity: int,
-                             out_byte_caps: Optional[Sequence[int]] = None
-                             ) -> ColumnBatch:
+                             out_byte_caps: Optional[Sequence[int]] = None,
+                             keep_encoded: bool = False) -> ColumnBatch:
     """Eager-path entry: ONE compiled dispatch assembles a whole target
     partition from k pid-sorted batches.  Segment positions are traced, so
     every partition of a shuffle (and every repeat query) reuses the same
@@ -403,13 +475,14 @@ def gather_segments_kway_run(batches: Sequence[ColumnBatch], starts, counts,
     if _GATHER_SEGMENTS_KWAY_JIT is None:
         _GATHER_SEGMENTS_KWAY_JIT = instrumented_jit(
             _gather_segments_kway_tuple, label="kernels:gatherSegmentsKway",
-            static_argnames=("out_capacity", "out_byte_caps"))
+            static_argnames=("out_capacity", "out_byte_caps", "keep_encoded"))
     return _GATHER_SEGMENTS_KWAY_JIT(
         tuple(batches),
         tuple(jnp.asarray(s, jnp.int32) for s in starts),
         tuple(jnp.asarray(c, jnp.int32) for c in counts),
         out_capacity,
-        tuple(out_byte_caps) if out_byte_caps else None)
+        tuple(out_byte_caps) if out_byte_caps else None,
+        keep_encoded)
 
 
 _GATHER_SEGMENTS_KWAY_JIT = None

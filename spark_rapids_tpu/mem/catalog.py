@@ -474,6 +474,37 @@ class BufferCatalog:
                     f"scan {host}")
             return problems
 
+    def verify_encoded_host_batches(self) -> List[str]:
+        """Encoded-corridor invariant half (analysis/plan_verify.py): a
+        host-tier handle holding dictionary-encoded columns must be
+        structurally reconstructible — non-empty dictionary, integer
+        codes inside it — or unspill would rebuild a different column."""
+        with self._lock:
+            hosts = [(hid, h._host) for hid, h in self._handles.items()
+                     if h.tier == SpillableBatch.TIER_HOST and
+                     h._host is not None]
+        problems = []
+        for hid, hb in hosts:
+            for f, c in zip(hb.schema.fields, hb.columns):
+                if c.dictionary is None:
+                    continue
+                codes = np.asarray(c.values)
+                nd = len(c.dictionary)
+                if codes.dtype.kind not in "iu":
+                    problems.append(
+                        f"catalog handle {hid}: encoded column {f.name!r} "
+                        f"has non-integer codes dtype {codes.dtype}")
+                elif nd == 0:
+                    problems.append(
+                        f"catalog handle {hid}: encoded column {f.name!r} "
+                        "has an empty dictionary")
+                elif len(codes) and (int(codes.min()) < 0 or
+                                     int(codes.max()) >= nd):
+                    problems.append(
+                        f"catalog handle {hid}: encoded column {f.name!r} "
+                        f"codes outside [0, {nd})")
+        return problems
+
     # -- spill state machine ------------------------------------------------
 
     def _begin_spill_locked(self, victim: SpillableBatch) -> _SpillTask:
@@ -551,7 +582,7 @@ class BufferCatalog:
         try:
             from spark_rapids_tpu.fault import inject
             inject.maybe_fire("spill")
-            host = device_to_host(dev)
+            host = device_to_host(dev, keep_dictionary=True)
             nbytes = host_batch_bytes(host)
             with self._lock:
                 live = h._spill_task is task and \
@@ -816,7 +847,8 @@ class BufferCatalog:
                 moved += 1
                 if rescue and not was_spilling:
                     try:
-                        host = device_to_host(h._device)
+                        host = device_to_host(h._device,
+                                              keep_dictionary=True)
                         h._host = host
                         h._host_nbytes = host_batch_bytes(host)
                         h._device = None

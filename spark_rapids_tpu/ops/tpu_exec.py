@@ -887,6 +887,24 @@ class TpuHashAggregateExec(TpuExec):
                            merged.capacity)
 
 
+def _eval_join_keys(exprs, batch, dict_keys: bool):
+    """Evaluate equi-join key expressions against one side's batch.
+
+    With ``dict_keys`` (spark.rapids.sql.tpu.join.dictKeys.enabled),
+    string keys that arrived dictionary-encoded from the scan/shuffle
+    corridor stay encoded — join_pairs then hashes/compares int32 codes
+    when both sides align (rendezvous-translating divergent dictionaries)
+    and falls back to content hashing THROUGH the codes otherwise, both
+    bit-identical to materialized keys.  Off: keys materialize here, so
+    the kernel never sees codes."""
+    from spark_rapids_tpu.exprs.base import eval_maybe_encoded
+    ctx = TpuEvalCtx(batch)
+    if dict_keys:
+        return [eval_maybe_encoded(e, ctx) if e.dtype.is_string
+                else e.tpu_eval(ctx) for e in exprs]
+    return [e.tpu_eval(ctx) for e in exprs]
+
+
 class TpuShuffledHashJoinExec(TpuExec):
     """Equi-join per co-partitioned pair (GpuShuffledHashJoinExec analogue).
     Residual conditions are applied as a post-join filter for inner joins
@@ -910,6 +928,8 @@ class TpuShuffledHashJoinExec(TpuExec):
 
     def partitions(self, ctx):
         import itertools
+        from spark_rapids_tpu.config import JOIN_DICT_KEYS_ENABLED
+        self._dict_keys = JOIN_DICT_KEYS_ENABLED.get(ctx.conf)
         lchild, rchild = self.children
         if self.num_partitions(ctx) > 1:
             switched = self._try_broadcast_switch(ctx)
@@ -1139,10 +1159,9 @@ class TpuShuffledHashJoinExec(TpuExec):
                 # left_semi with empty right = empty
                 return None
             rb = empty_device_batch(rsch)
-        lctx = TpuEvalCtx(lb)
-        rctx = TpuEvalCtx(rb)
-        lkeys = [e.tpu_eval(lctx) for e in self.left_keys]
-        rkeys = [e.tpu_eval(rctx) for e in self.right_keys]
+        dict_keys = getattr(self, "_dict_keys", False)
+        lkeys = _eval_join_keys(self.left_keys, lb, dict_keys)
+        rkeys = _eval_join_keys(self.right_keys, rb, dict_keys)
         # the residual condition runs INSIDE the join (it gates matches
         # before null-padding — GpuHashJoin.scala:265-271), so outer and
         # semi/anti joins with conditions are correct on device
@@ -1400,9 +1419,11 @@ class TpuBroadcastHashJoinExec(TpuExec):
         return handle
 
     def partitions(self, ctx):
+        from spark_rapids_tpu.config import JOIN_DICT_KEYS_ENABLED
         bh = self._broadcast_handle(ctx)
         bc_schema = self.children[1].output_schema
         stream_schema = self.children[0].output_schema
+        dict_keys = JOIN_DICT_KEYS_ENABLED.get(ctx.conf)
 
         def gen(part):
             for sb in part:
@@ -1414,10 +1435,8 @@ class TpuBroadcastHashJoinExec(TpuExec):
                     lb, rb = sb, bc_local
                 else:
                     lb, rb = bc_local, sb
-                lctx = TpuEvalCtx(lb)
-                rctx = TpuEvalCtx(rb)
-                lkeys = [e.tpu_eval(lctx) for e in self.left_keys]
-                rkeys = [e.tpu_eval(rctx) for e in self.right_keys]
+                lkeys = _eval_join_keys(self.left_keys, lb, dict_keys)
+                rkeys = _eval_join_keys(self.right_keys, rb, dict_keys)
                 yield hash_join(lb, lkeys, rb, rkeys, self.how,
                                 self.output_schema,
                                 condition=self.condition)

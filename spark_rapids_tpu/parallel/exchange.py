@@ -125,9 +125,9 @@ class TpuShuffleExchangeExec(TpuExec):
         self.partitioning = partitioning
         self._input_fns = []
         self._fused_map = None
-        self._sort_by_pid = instrumented_jit(self._sort_by_pid_impl,
-                                             label="TpuShuffleExchange:split",
-                                             static_argnames=("n",))
+        self._sort_by_pid = instrumented_jit(
+            self._sort_by_pid_impl, label="TpuShuffleExchange:split",
+            static_argnames=("n", "keep_encoded"))
 
     def absorb_input(self, fns):
         """Fuse upstream map-like stages into the partition-split program
@@ -279,7 +279,7 @@ class TpuShuffleExchangeExec(TpuExec):
         return f
 
     def _sort_by_pid_impl(self, batch: ColumnBatch, part_index, n: int,
-                          bound_words=None):
+                          bound_words=None, keep_encoded: bool = False):
         """One pass: rows reordered so each target partition's rows are
         contiguous (the GPU `Table.partition` + contiguousSplit shape,
         GpuPartitioning.scala:44-117).  Returns (sorted batch, per-target
@@ -288,7 +288,14 @@ class TpuShuffleExchangeExec(TpuExec):
         ``bound_words`` (range partitioning only): pre-encoded range-bound
         word arrays passed as TRACED arguments, so range splits ride the
         same jitted program as hash/round-robin instead of the eager
-        per-bound path."""
+        per-bound path.
+
+        ``keep_encoded`` (dict-aware shuffle): the pid-sort permutes
+        dictionary codes instead of materializing string bytes.  Byte
+        totals always report MATERIALIZED per-target element totals for
+        encoded columns (per-row entry lengths gathered through the
+        codes) — they size the materialize-path byte caps and the
+        encoded-path ``mat_byte_cap`` alike."""
         for f in self._input_fns:
             batch = f(batch)
         cap = batch.capacity
@@ -300,7 +307,8 @@ class TpuShuffleExchangeExec(TpuExec):
         live = jnp.arange(cap, dtype=jnp.int32) < batch.num_rows
         ids = jnp.where(live, ids, n)
         order = jnp.argsort(ids, stable=True).astype(jnp.int32)
-        sorted_batch = gather_rows(batch, order, batch.num_rows)
+        sorted_batch = gather_rows(batch, order, batch.num_rows,
+                                   keep_encoded=keep_encoded)
         counts = jnp.zeros(n + 1, jnp.int32).at[ids].add(1)[:n]
         byte_totals = []
         for c in batch.columns:
@@ -309,7 +317,14 @@ class TpuShuffleExchangeExec(TpuExec):
             # gather_rows' varlen columns; totals are in element units
             # (bytes for strings, element count for arrays)
             if c.is_varlen:
-                lens = (c.offsets[1:] - c.offsets[:-1]).astype(jnp.int64)
+                if c.codes is not None:
+                    nd = int(c.offsets.shape[0]) - 1
+                    ent_lens = (c.offsets[1:] - c.offsets[:-1]) \
+                        .astype(jnp.int64)
+                    codes_c = jnp.clip(c.codes, 0, max(nd - 1, 0))
+                    lens = jnp.where(c.validity, ent_lens[codes_c], 0)
+                else:
+                    lens = (c.offsets[1:] - c.offsets[:-1]).astype(jnp.int64)
                 byte_totals.append(jax.ops.segment_sum(
                     lens, ids, num_segments=n + 1)[:n])
         return sorted_batch, counts, byte_totals
@@ -504,7 +519,9 @@ class TpuShuffleExchangeExec(TpuExec):
         exceeds splitCoalesceMaxBytes falls back to per-batch pieces so
         the catalog can still spill early pieces independently."""
         from spark_rapids_tpu.batch import round_up_capacity
-        from spark_rapids_tpu.config import SHUFFLE_COALESCE_MAX_BYTES
+        from spark_rapids_tpu.config import (
+            SHUFFLE_COALESCE_MAX_BYTES, SHUFFLE_DICT_AWARE,
+        )
         from spark_rapids_tpu.kernels.layout import gather_segments_kway_run
         from spark_rapids_tpu.mem.catalog import PRIORITY_SHUFFLE_OUTPUT
         bound_words = None
@@ -512,10 +529,20 @@ class TpuShuffleExchangeExec(TpuExec):
             # one batched H2D + one encode for ALL N-1 bounds; the word
             # arrays ride the jitted pid-sort as traced arguments
             bound_words = self.partitioning.encode_bounds_device()
+        # dict-aware split (docs/shuffle.md): when any input column is
+        # dictionary-encoded, the pid-sort permutes 4-byte codes and the
+        # piece gather merges dictionaries instead of materializing string
+        # bytes — decided BEFORE dispatch because it is a static arg of
+        # the sort program (one cache key per mode, stable per query)
+        keep_enc = SHUFFLE_DICT_AWARE.get(ctx.conf) and any(
+            c.codes is not None
+            for batches in all_batches for db in batches
+            for c in db.columns)
         sorted_all = []
         for pi, batches in enumerate(all_batches):
             for db in batches:
-                sorted_all.append(self._sort_by_pid(db, pi, n, bound_words))
+                sorted_all.append(self._sort_by_pid(
+                    db, pi, n, bound_words, keep_encoded=keep_enc))
                 ctx.metric(self.op_id, "shuffleSplitDispatches").add(1)
         if not sorted_all:
             return
@@ -527,15 +554,40 @@ class TpuShuffleExchangeExec(TpuExec):
         starts_h = [np.concatenate(([0], np.cumsum(c)))[:n]
                     for c in counts_h]
         cap_bytes = SHUFFLE_COALESCE_MAX_BYTES.get(ctx.conf)
+        varlen_idx = [i for i, f in enumerate(self.output_schema.fields)
+                      if f.dtype.is_string or f.dtype.is_array]
+
+        def _col_encoded(ci, group):
+            # encoded output requires EVERY contributing part encoded
+            # (gather_segments_kway materializes mixed columns)
+            return keep_enc and all(
+                sorted_all[b][0].columns[varlen_idx[ci]].codes is not None
+                for b in group)
+
+        def _hbm_bytes(group, p, rows):
+            # actual piece footprint: codes + dictionary buffers for
+            # encoded columns, materialized elements otherwise — encoded
+            # columns shrink the coalescing budget's view of a piece, so
+            # more batches coalesce under the same cap
+            t = rows * frb
+            for ci, sc in enumerate(vscales):
+                if _col_encoded(ci, group):
+                    t += 4 * rows + sum(
+                        int(sorted_all[b][0]
+                            .columns[varlen_idx[ci]].data.shape[0])
+                        for b in group)
+                else:
+                    t += sum(int(bytes_h[b][ci][p]) for b in group) * sc
+            return t
+
+        saved_total = 0
         for p in range(n):
             segs = [b for b in range(len(sorted_all))
                     if counts_h[b][p] > 0]
             if not segs:
                 continue
             total_rows = sum(int(counts_h[b][p]) for b in segs)
-            total_bytes = total_rows * frb + sum(
-                int(bytes_h[b][ci][p]) * sc
-                for b in segs for ci, sc in enumerate(vscales))
+            total_bytes = _hbm_bytes(segs, p, total_rows)
             if cap_bytes > 0 and total_bytes > cap_bytes and len(segs) > 1:
                 groups = [[b] for b in segs]
             else:
@@ -545,20 +597,35 @@ class TpuShuffleExchangeExec(TpuExec):
                 elems = [sum(int(bytes_h[b][ci][p]) for b in group)
                          for ci in range(len(vscales))]
                 pcap = round_up_capacity(rows)
+                # encoded columns: the slot is the OUTPUT mat_byte_cap —
+                # same bucket of the same materialized total the plain
+                # path would allocate, so downstream sizing is identical
                 bcaps = [round_up_capacity(max(e, 16), minimum=16)
                          for e in elems]
                 piece = gather_segments_kway_run(
                     [sorted_all[b][0] for b in group],
                     [int(starts_h[b][p]) for b in group],
                     [int(counts_h[b][p]) for b in group],
-                    pcap, bcaps or None)
+                    pcap, bcaps or None, keep_encoded=keep_enc)
                 ctx.metric(self.op_id, "shuffleSplitDispatches").add(1)
+                for ci, sc in enumerate(vscales):
+                    if _col_encoded(ci, group):
+                        wire = 4 * rows + sum(
+                            int(sorted_all[b][0]
+                                .columns[varlen_idx[ci]].data.shape[0])
+                            for b in group)
+                        saved_total += max(0, elems[ci] * sc - wire)
                 h = catalog.register(piece, PRIORITY_SHUFFLE_OUTPUT)
                 h.piece_rows = rows  # host-known: no sync for AQE sizing
+                # piece_bytes stays the MATERIALIZED size either way, so
+                # AQE coalescing decisions are bit-identical to encoded-off
                 h.piece_bytes = rows * frb + sum(
                     e * sc for e, sc in zip(elems, vscales))
                 ctx.defer_close(h)
                 out[p].append(h)
+        if keep_enc:
+            ctx.metric(self.op_id, "shuffleEncodedBytesSaved").add(
+                saved_total)
 
     def _split_v1(self, ctx, all_batches, n, catalog, frb, vscales, out):
         """Legacy per-batch split (one count sync per batch, one gather
@@ -641,11 +708,16 @@ def _sample_device_keys(all_batches: List[List[ColumnBatch]],
     sub-batches.  The old path device_to_host'd every FULL batch (values
     included) just to read the first rows."""
     from spark_rapids_tpu.batch import device_to_host_many, round_up_capacity
+    from spark_rapids_tpu.kernels.layout import dict_decode_column
     rows: List[tuple] = []
+    # dict-encoded key columns (encoded corridor) materialize up front:
+    # the offsets metadata below must describe ROW offsets, and bounds
+    # need string content regardless
     subs = [ColumnBatch(
                 T.Schema([db.schema.fields[i] for i in key_ordinals]),
-                [db.columns[i] for i in key_ordinals], db.num_rows,
-                db.capacity)
+                [dict_decode_column(c) if c.codes is not None else c
+                 for c in (db.columns[i] for i in key_ordinals)],
+                db.num_rows, db.capacity)
             for batches in all_batches for db in batches]
     if not subs:
         return rows

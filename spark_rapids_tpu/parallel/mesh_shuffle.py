@@ -557,6 +557,19 @@ def mesh_exchange_batches(mesh: Mesh, local_batches, pids_list,
             cols, nr, pid = _empty_cols(schema, ecaps), 0, \
                 jnp.zeros(cap, jnp.int32)
         else:
+            if any(c.codes is not None for c in b.columns):
+                # Dictionary-encoded columns materialize before packing:
+                # the collective's wire format is (elements, lens,
+                # validity) per varlen column, and host_sizes above
+                # already sized ecaps at MATERIALIZED totals.  (The
+                # single-host exchange keeps codes on the wire —
+                # exchange.dictAware — but cross-device pieces would each
+                # need the whole dictionary; see docs/shuffle.md.)
+                from spark_rapids_tpu.kernels.layout import ensure_row_layout
+                b = ensure_row_layout(b)
+                if stats is not None:
+                    stats["encoded_materialized"] = \
+                        stats.get("encoded_materialized", 0) + 1
             cols, nr, pid = list(b.columns), b.num_rows, pids_list[d]
         moved = jax.device_put((cols, nr, pid), devices[d])
         payloads = pack(*moved)

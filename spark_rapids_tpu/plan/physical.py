@@ -380,6 +380,11 @@ class DeviceToHostExec(CpuExec):
                 # trip + a device-side gather) so the bulk transfer moves
                 # live rows, not padded capacity.
                 hb = device_to_host(shrink_to_fit(db))
+                if any(c.dictionary is not None for c in hb.columns):
+                    # encoded-corridor invariant (analysis/plan_verify):
+                    # collection D2H must materialize dictionary columns
+                    ctx.encoded_d2h_leaks = \
+                        getattr(ctx, "encoded_d2h_leaks", 0) + 1
                 if ctx.semaphore is not None:
                     _release_admission(ctx)
                 if hb.num_rows:

@@ -335,6 +335,14 @@ class TpuSparkSession:
         frame.last_metrics["shuffleWallNs"] = sum(
             ms["shuffleWallNs"].value for ms in ctx.metrics.values()
             if "shuffleWallNs" in ms)
+        # dict-aware shuffle economics: materialized string bytes the
+        # split did NOT move because pieces stayed dictionary-encoded
+        # (codes + merged dictionary instead of raw bytes); 0 when the
+        # query shuffled no encoded columns or dictAware is off
+        frame.last_metrics["shuffleEncodedBytesSaved"] = sum(
+            ms["shuffleEncodedBytesSaved"].value
+            for ms in ctx.metrics.values()
+            if "shuffleEncodedBytesSaved" in ms)
         # mesh-SPMD economics (parallel.mesh_spmd): whole-stage programs
         # dispatched, exchange boundaries fused into them (each one is a
         # shuffle that ran as an in-program all_to_all with ZERO host
@@ -363,6 +371,14 @@ class TpuSparkSession:
         frame.last_metrics["scanBytesDecoded"] = _scan_sum("scanBytesDecoded")
         frame.last_metrics["scanDictColumns"] = _scan_sum("scanDictColumns")
         frame.last_metrics["scanChunksSkipped"] = _scan_sum("scanChunksSkipped")
+        # adaptive read-ahead: the deepest effective depth any scan op's
+        # controller reached this query (equals the static conf when the
+        # user pinned scan.readAhead.depth explicitly)
+        _depths = [ms["readaheadDepthEffective"].value
+                   for ms in ctx.metrics.values()
+                   if "readaheadDepthEffective" in ms]
+        frame.last_metrics["readaheadDepthEffective"] = \
+            max(_depths) if _depths else 0
         # adaptive-execution economics (plan/adaptive), summed over every
         # op that replanned: partitions merged away by post-shuffle
         # coalescing, joins switched to the broadcast shape at runtime,
