@@ -732,6 +732,7 @@ s = TpuSparkSession(RapidsConf({
     "spark.rapids.sql.variableFloatAgg.enabled": True,
     "spark.rapids.sql.tpu.mesh.spmd.enabled": spmd,
     "spark.sql.shuffle.partitions": max(2, n),
+    "spark.sql.autoBroadcastJoinThreshold": 0,
 }))
 df = s.create_dataframe({
     "k": (T.INT, rng.randint(0, 64, rows).astype(np.int32).tolist()),
@@ -743,10 +744,27 @@ t0 = time.monotonic()
 q.collect()
 wall = time.monotonic() - t0
 m = s.last_metrics
+# join-bearing query: a shuffled hash join ACROSS the exchange, fused
+# into the same shard_map program when SPMD is on (threshold 0 above
+# keeps the hash strategy)
+right = s.create_dataframe({
+    "k": (T.INT, list(range(64))),
+    "w": (T.LONG, [i * 3 for i in range(64)]),
+}, num_partitions=2)
+jq = df.join(right, on="k", how="inner").group_by("k").agg(
+    F.sum(F.col("w")).alias("sw"))
+jq.collect()  # warmup (compile)
+t0 = time.monotonic()
+jq.collect()
+jwall = time.monotonic() - t0
+jm = s.last_metrics
 print(json.dumps({
     "rows_per_sec": round(rows / wall, 1) if wall > 0 else 0.0,
     "backend": m.get("meshBackend", ""),
     "fused": m.get("meshBoundariesFused", 0),
+    "join_rows_per_sec": round(rows / jwall, 1) if jwall > 0 else 0.0,
+    "join_fused": jm.get("meshJoinsFused", 0),
+    "fallbacks": jm.get("meshFallbacks", 0),
 }))
 """
 
@@ -776,20 +794,28 @@ def time_mesh():
             return json.loads(line)
         except (subprocess.TimeoutExpired, IndexError,
                 json.JSONDecodeError):
-            return {"rows_per_sec": 0.0, "backend": "", "fused": 0}
+            return {"rows_per_sec": 0.0, "backend": "", "fused": 0,
+                    "join_rows_per_sec": 0.0, "join_fused": 0,
+                    "fallbacks": 0}
 
     curve = {}
+    join_curve = {}
     backend = ""
+    join_fused = 0
+    fallbacks = 0
     for n in (1, 2, 4, 8):
         r = child(n, True)
         curve[str(n)] = r["rows_per_sec"]
+        join_curve[str(n)] = r.get("join_rows_per_sec", 0.0)
+        join_fused = max(join_fused, r.get("join_fused", 0))
+        fallbacks += r.get("fallbacks", 0)
         if r["backend"]:
             backend = r["backend"]
     off = child(8, False)
     on_rps = curve.get("8", 0.0)
     ratio = round(on_rps / off["rows_per_sec"], 3) \
         if off["rows_per_sec"] else 0.0
-    return curve, ratio, backend
+    return curve, ratio, backend, join_curve, join_fused, fallbacks
 
 
 def main():
@@ -841,7 +867,8 @@ def main():
     serve = time_serve()
     frontend = time_frontend()
     history_speedup, history_hits, history_alerts = time_history()
-    mesh_curve, mesh_ratio, mesh_backend = time_mesh()
+    (mesh_curve, mesh_ratio, mesh_backend, mesh_join_curve,
+     mesh_join_fused, mesh_fallbacks) = time_mesh()
 
     data_bytes = ROWS * _bytes_per_row(data)
     device_s = tpu_econ["device_ms"] / 1e3
@@ -966,6 +993,14 @@ def main():
         "mesh_rows_per_sec_by_devices": mesh_curve,
         "mesh_spmd_vs_hostdriven": mesh_ratio,
         "mesh_backend": mesh_backend,
+        # mesh-SPMD v2 fused-join lane: a shuffled hash join compiled
+        # INTO the fused program — fused-join count at the widest mesh
+        # (>=1 = the join actually fused), the join query's rows/s
+        # scaling curve, and the overflow/compat fallback count across
+        # all SPMD-on children (0 = default growth never overflowed)
+        "mesh_join_fused": mesh_join_fused,
+        "mesh_join_rows_per_sec_by_devices": mesh_join_curve,
+        "mesh_fallback_count": mesh_fallbacks,
         "platform": platform,
         "scan_rows_per_sec": round(SCAN_ROWS / scan_tpu, 1),
         "scan_vs_baseline": round(scan_cpu / scan_tpu, 3),

@@ -79,6 +79,8 @@ for key in ("value", "donated_bytes", "h2d_gb_per_sec", "d2h_gb_per_sec",
             "shuffle_wire_gb_per_sec", "shuffle_encoded_bytes_saved",
             "mesh_rows_per_sec_by_devices",
             "mesh_spmd_vs_hostdriven", "mesh_backend",
+            "mesh_join_fused", "mesh_join_rows_per_sec_by_devices",
+            "mesh_fallback_count",
             "history_warm_speedup", "fragment_cache_hits",
             "telemetry_overhead_pct", "critpath_top_site",
             "regression_alerts",
@@ -106,6 +108,12 @@ assert j["frontend_second_client_compiles"] == 0, j
 assert j["result_cache_hits"] > 0, j
 assert float(j["frontend_queries_per_sec"]) > 0, j
 assert isinstance(j["mesh_rows_per_sec_by_devices"], dict), j
+# fused-join lane gates: the shuffled hash join must actually compile
+# into the fused program, with zero overflow/compat fallbacks at the
+# default growth factor
+assert j["mesh_join_fused"] >= 1, j
+assert isinstance(j["mesh_join_rows_per_sec_by_devices"], dict), j
+assert j["mesh_fallback_count"] == 0, j
 assert j["fragment_cache_hits"] > 0, j
 assert j["history_warm_speedup"] > 0, j
 # fused-vs-host-driven ratio is recorded, NOT gated: CPU virtual devices
@@ -534,6 +542,48 @@ assert m["shuffleSyncs"] >= 1, m
 print("exchange fault smoke ok:", {k: m[k] for k in (
     "retryCount", "faultsInjected", "shuffleSyncs",
     "shuffleSplitDispatches", "shufflePieces")})
+PY
+
+echo "== fault-injection smoke: mesh:device_lost@1 through a FUSED mesh"
+echo "   join program — the lost device replays the whole fused stage"
+echo "   bit-identically with retryCount > 0 and held_depth == 0"
+python - << 'PY'
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.session import TpuSparkSession
+
+def make(s):
+    left = s.create_dataframe(
+        {"k": [i % 13 for i in range(4096)],
+         "v": list(range(4096))}, num_partitions=4)
+    right = s.create_dataframe(
+        {"k": list(range(13)), "w": [i * 7 for i in range(13)]},
+        num_partitions=2)
+    return left.join(right, on="k", how="inner")
+
+BASE = {
+    "spark.rapids.sql.enabled": True,
+    "spark.rapids.shuffle.ici.enabled": True,
+    # threshold 0 keeps the shuffled-hash strategy: the join fuses INTO
+    # the mesh shard_map program (mesh.spmd.enabled is default-on)
+    "spark.sql.autoBroadcastJoinThreshold": 0,
+}
+clean = TpuSparkSession(RapidsConf(BASE))
+want = sorted(map(str, make(clean).collect()))
+assert clean.last_metrics["meshJoinsFused"] >= 1, clean.last_metrics
+
+s = TpuSparkSession(RapidsConf({
+    **BASE, "spark.rapids.sql.tpu.faults.spec": "mesh:device_lost@1"}))
+got = sorted(map(str, make(s).collect()))
+assert got == want, f"faulted fused join diverged:\n{got[:5]}\n{want[:5]}"
+m = s.last_metrics
+assert m["faultsInjected"] >= 1, m
+assert m["deviceLostCount"] >= 1, m
+assert m["retryCount"] > 0, m
+assert m["meshJoinsFused"] >= 1, m
+assert s.runtime.semaphore.held_depth() == 0
+print("mesh fused-join fault smoke ok:", {k: m[k] for k in (
+    "retryCount", "faultsInjected", "deviceLostCount",
+    "meshJoinsFused", "meshProgramDispatches")})
 PY
 
 echo "== adaptive smoke: skewed join coalesces with bit-identical rows"

@@ -168,18 +168,33 @@ def check_mesh_sharding(root) -> List[str]:
 
     * every program input/output leaf carries a DECLARED PartitionSpec,
       and each is either fully replicated (no named axis — broadcast
-      build sides) or leads with the mesh's ``data`` axis (row-sharded);
+      build sides) or leads with an axis some partitioning RULE declares
+      (parallel.partitioning.MESH_PARTITION_RULES — the same pytree the
+      lowering consults, so verifier and lowering cannot drift);
     * sharding boundaries flip only at explicit reshard nodes: the stage
       records the fused exchanges it resharded through, each of which
-      must be a shuffle exchange inside the stage root's subtree;
+      must be a shuffle exchange inside the stage root's subtree.  A
+      stage with NO reshard must have fused at least one join (a
+      broadcast join fuses exchange-free: its build side replicates);
+    * fused joins: each recorded join is a hash-join exec inside the
+      subtree; every replicated input leaf (broadcast build side) is
+      declared fully replicated (build side rides as ``P()``), and no
+      OUTPUT leaf is replicated — join output sharding derives from the
+      data-sharded probe side;
     * donation masks are all-False — a donated leaf of a mesh global
       would hand ONE shard's buffer to XLA while the other shards (and a
       device-lost replay) still reference the global."""
+    from spark_rapids_tpu.parallel.partitioning import (
+        MESH_PARTITION_RULES,
+    )
+    rule_axes = {spec[0] for _, spec in MESH_PARTITION_RULES
+                 if spec is not None}
     problems = []
     for op in _walk(root):
         specs = getattr(op, "_mesh_partition_specs", None)
         if not isinstance(specs, dict):
             continue
+        replicated = set(specs.get("replicated", ()))
         for role in ("in_specs", "out_specs"):
             for i, spec in enumerate(specs.get(role, ())):
                 axes = tuple(spec) if spec is not None else None
@@ -188,13 +203,20 @@ def check_mesh_sharding(root) -> List[str]:
                         f"{_describe(op)}: mesh {role}[{i}] has no "
                         "declared PartitionSpec")
                 elif not all(a is None for a in axes) and \
-                        (not axes or axes[0] != "data"):
+                        (not axes or axes[0] not in rule_axes):
                     problems.append(
                         f"{_describe(op)}: mesh {role}[{i}] = {spec} is "
-                        "neither replicated nor leading with the 'data' "
-                        "axis")
+                        "neither replicated nor leading with a "
+                        "rule-declared mesh axis")
+                elif role == "out_specs" and specs.get("joins") and \
+                        all(a is None for a in axes):
+                    problems.append(
+                        f"{_describe(op)}: mesh out_specs[{i}] is "
+                        "replicated, but a fused join's output must be "
+                        "data-sharded like its probe side")
         reshards = list(specs.get("reshards", ()))
-        if not reshards:
+        joins = list(specs.get("joins", ()))
+        if not reshards and not joins:
             problems.append(
                 f"{_describe(op)}: fused mesh stage records no reshard "
                 "(exchange) boundary")
@@ -211,6 +233,24 @@ def check_mesh_sharding(root) -> List[str]:
                     f"{_describe(op)}: mesh reshard {ex_id} is a "
                     f"{type(ex).__name__}, not a shuffle exchange — "
                     "sharding may only flip at explicit reshard nodes")
+        for j_id in joins:
+            j = subtree_ids.get(j_id)
+            if j is None:
+                problems.append(
+                    f"{_describe(op)}: fused mesh join {j_id} is not in "
+                    "the stage root's subtree")
+                continue
+            if "HashJoin" not in type(j).__name__:
+                problems.append(
+                    f"{_describe(op)}: fused mesh join {j_id} is a "
+                    f"{type(j).__name__}, not a hash join exec")
+        in_specs = list(specs.get("in_specs", ()))
+        for i in replicated:
+            if i < len(in_specs) and in_specs[i] is not None and \
+                    not all(a is None for a in tuple(in_specs[i])):
+                problems.append(
+                    f"{_describe(op)}: broadcast build leaf {i} must be "
+                    f"fully replicated (P()), got {in_specs[i]}")
         if any(specs.get("dmask", ())):
             problems.append(
                 f"{_describe(op)}: donation under mesh sharding "

@@ -258,22 +258,33 @@ ENABLE_ICI_SHUFFLE = conf_bool(
     "reference's RapidsShuffleManager (docs/get-started.md); off means the "
     "single-host exchange path.")
 MESH_SPMD_ENABLED = conf_bool(
-    "spark.rapids.sql.tpu.mesh.spmd.enabled", False,
+    "spark.rapids.sql.tpu.mesh.spmd.enabled", True,
     "Fuse contiguous plan segments on either side of a mesh shuffle into "
-    "ONE shard_map program: the exchange lowers to an in-program "
-    "lax.all_to_all over the ICI, broadcast-join build sides replicate "
-    "(PartitionSpec ()) and the boundary runs with zero host syncs "
-    "(host-driven mesh shuffle pays 1 sync + a restage per exchange).  "
-    "Requires shuffle.ici.enabled and >1 device; segments containing a "
-    "mesh-incompatible op (range/single partitioning, shuffled hash "
-    "join) stay on the host-driven path.  Bit-identical either way.")
+    "ONE shard_map program: exchanges (hash, round-robin AND range — "
+    "range bounds are sampled/sorted/picked in-program) lower to "
+    "in-program lax.all_to_all collectives, joins run per-shard with "
+    "capacity-bucketed static output sizing, broadcast-join build sides "
+    "replicate (PartitionSpec ()) and the whole stage runs with zero "
+    "host syncs (host-driven mesh shuffle pays 1 sync + a restage per "
+    "exchange).  Requires shuffle.ici.enabled and >1 device; "
+    "single-partition collapses are the only remaining host-driven "
+    "fallback (see mesh.spmd.autoFallback).  Bit-identical either way.")
 MESH_SPMD_AUTO_FALLBACK = conf_bool(
     "spark.rapids.sql.tpu.mesh.spmd.autoFallback", True,
     "With mesh.spmd.enabled, silently route mesh-incompatible exchanges "
-    "(range partitioning, single-partition collapses) through the "
-    "host-driven mesh shuffle instead of failing.  false raises on the "
-    "first incompatible exchange — a debugging aid to catch segments "
-    "dropping out of whole-stage SPMD fusion.")
+    "(single-partition collapses) through the host-driven mesh shuffle, "
+    "and rerun a fused stage host-driven when a join's bucketed output "
+    "capacity overflows, instead of failing.  false raises on the first "
+    "incompatible exchange — a debugging aid to catch segments dropping "
+    "out of whole-stage SPMD fusion.")
+MESH_SPMD_JOIN_GROWTH = conf_float(
+    "spark.rapids.sql.tpu.mesh.spmd.join.growthFactor", 2.0,
+    "Pair-capacity growth factor for joins fused into a mesh-SPMD "
+    "program: the per-shard static pair capacity is the bucket-quantized "
+    "probe capacity times this factor (the host-driven path instead "
+    "host-syncs the exact total).  Joins whose true pair count exceeds "
+    "the bucket set an in-program overflow flag and the stage reruns "
+    "host-driven (mesh.spmd.autoFallback).")
 PINNED_POOL_SIZE = conf_bytes(
     "spark.rapids.memory.pinnedPool.size", 0,
     "Size of the pinned host staging pool used by the native runtime for "

@@ -31,7 +31,9 @@ from spark_rapids_tpu import types as T
 from spark_rapids_tpu.batch import ColumnBatch, round_up_capacity
 from spark_rapids_tpu.utils.compile_registry import instrumented_jit
 from spark_rapids_tpu.exprs.base import DevVal
-from spark_rapids_tpu.kernels.layout import compaction_indices, gather_rows
+from spark_rapids_tpu.kernels.layout import (
+    compaction_indices, ensure_row_layout, gather_rows,
+)
 
 _C1 = np.uint32(0xCC9E2D51)
 _C2 = np.uint32(0x1B873593)
@@ -336,6 +338,228 @@ def join_pairs(left_keys: List[DevVal], left_num_rows,
                   total)
 
 
+def join_pairs_static(left_keys: List[DevVal], left_num_rows,
+                      right_keys: List[DevVal], right_num_rows,
+                      pair_cap: int):
+    """Fully-traced :func:`join_pairs`: the pair capacity is a STATIC
+    argument chosen by the caller (mesh SPMD fuses the join into one
+    ``shard_map`` program, so there is no host to read the phase-1 total).
+
+    Returns ``(l_idx, r_idx, n_pairs, l_counts, r_matched, overflow)``
+    where ``overflow`` is a traced bool: the true pair total exceeded
+    ``pair_cap``.  On overflow the pair list is TRUNCATED (results are
+    wrong) — the caller must check the flag and fall back to the
+    host-driven two-phase path.  Safe inside ``jax.jit`` / ``shard_map``.
+    """
+    l_cap = int(left_keys[0].validity.shape[0])
+    r_cap = int(right_keys[0].validity.shape[0])
+    l_live = jnp.arange(l_cap, dtype=jnp.int32) < left_num_rows
+    r_live = jnp.arange(r_cap, dtype=jnp.int32) < right_num_rows
+
+    # encoded corridor: alignment decisions depend only on host-known
+    # dictionary shapes / object identity, so they are trace-safe
+    l_over: List[Optional[jnp.ndarray]] = []
+    r_over: List[Optional[jnp.ndarray]] = []
+    for lv, rv in zip(left_keys, right_keys):
+        pair = align_dict_codes(lv, rv)
+        l_over.append(None if pair is None else pair[0])
+        r_over.append(None if pair is None else pair[1])
+    any_over = any(o is not None for o in l_over)
+
+    l_h1, _l_h2, l_ok = _key_hash2(left_keys, l_over if any_over else None)
+    r_h1, r_h2, r_ok = _key_hash2(right_keys, r_over if any_over else None)
+    sentinel = ~jnp.uint32(0)
+    r_h1 = jnp.where(r_live & r_ok, r_h1, sentinel)
+    perm, r_sorted = _build_sort(r_h1, r_h2)
+    lo, counts, total = _phase1(l_h1, l_ok, l_live, r_sorted,
+                                right_num_rows)
+    overflow = total > pair_cap
+    total_c = jnp.minimum(total, pair_cap)
+
+    code_pairs = [None if a is None else (a, b)
+                  for a, b in zip(l_over, r_over)] if any_over else None
+    cum = jnp.cumsum(counts)
+    starts = cum - counts
+    k = jnp.arange(pair_cap, dtype=jnp.int32)
+    probe_row = jnp.searchsorted(cum, k, side="right").astype(jnp.int32)
+    probe_row = jnp.clip(probe_row, 0, l_cap - 1)
+    ordinal = (k - starts[probe_row]).astype(jnp.int32)
+    build_pos = jnp.clip(lo[probe_row] + ordinal, 0, r_cap - 1)
+    build_row = perm[build_pos]
+    in_range = k < total_c
+    match = in_range & _exact_eq(left_keys, probe_row, right_keys,
+                                 build_row, code_pairs)
+    order = jnp.argsort(jnp.where(match, 0, 1), stable=True)
+    n_pairs = jnp.sum(match).astype(jnp.int32)
+    l_idx = probe_row[order].astype(jnp.int32)
+    r_idx = build_row[order].astype(jnp.int32)
+    ones = match.astype(jnp.int32)
+    l_counts = jax.ops.segment_sum(ones, probe_row, num_segments=l_cap,
+                                   indices_are_sorted=True)
+    r_matched = jax.ops.segment_max(ones, build_row,
+                                    num_segments=r_cap) > 0
+    return l_idx, r_idx, n_pairs, l_counts, r_matched, overflow
+
+
+def _static_byte_caps(batch: ColumnBatch, growth: float,
+                      out_cap: int = 0) -> List[int]:
+    """Static growth-scaled output byte capacities per varlen column.
+
+    A join gather can DUPLICATE one side's rows up to the pair count (a
+    6-row build side probed by 200 rows emits its strings ~200 times),
+    so input bytes alone under-size wildly: scale by the row expansion
+    ``out_cap / capacity`` too — growth x expansion x input bytes holds
+    as long as the duplicated rows' average length stays within growth of
+    the input average; the in-program needed-bytes check catches the
+    adversarial tail.  ``batch`` must already be in row layout."""
+    expand = max(1.0, out_cap / batch.capacity) if out_cap else 1.0
+    return [round_up_capacity(
+        max(int(int(c.data.shape[0]) * growth * expand), 1), minimum=16)
+        for c in batch.columns if c.is_varlen]
+
+
+def _needed_bytes(batch: ColumnBatch, indices, live) -> List[jnp.ndarray]:
+    """Traced per-varlen-column byte totals a gather at ``indices`` needs
+    (the in-program sibling of :func:`_string_byte_caps` — no host sync).
+    ``batch`` must already be in row layout."""
+    needs = []
+    for c in batch.columns:
+        if c.is_varlen:
+            lens = (c.offsets[1:] - c.offsets[:-1]).astype(jnp.int32)
+            needs.append(jnp.sum(jnp.where(
+                live, lens[jnp.clip(indices, 0, batch.capacity - 1)], 0)))
+    return needs
+
+
+def _caps_overflow(needs: List[jnp.ndarray], caps: List[int]):
+    """Traced bool: any needed byte total exceeds its static capacity.
+    Mandatory check — :func:`gather_rows` silently truncates varlen data
+    past the byte cap (its ``in_range`` mask), so an undetected overflow
+    would corrupt output instead of failing."""
+    ovf = jnp.asarray(False)
+    for need, cap in zip(needs, caps):
+        ovf = ovf | (need > cap)
+    return ovf
+
+
+def stitch_join_output_static(left: ColumnBatch, right: ColumnBatch,
+                              l_idx, r_idx, n_pairs, l_counts, r_matched,
+                              join_type: str, out_schema: T.Schema,
+                              growth: float):
+    """Traced :func:`stitch_join_output` with STATIC output capacities.
+
+    Row capacities: semi/anti at the left capacity (a filter — can never
+    overflow); inner at the pair capacity; outer at
+    ``round_up_capacity(pair_cap + l_cap + r_cap)`` (pairs plus every
+    possibly-unmatched row — also exact, never overflows).  Varlen byte
+    capacities are growth-scaled static buckets with an in-program
+    needed-bytes check.  Returns ``(batch, overflow)``; on overflow the
+    batch content is invalid and the caller must fall back."""
+    left = ensure_row_layout(left)
+    right = ensure_row_layout(right)
+    l_cap, r_cap = left.capacity, right.capacity
+    pair_cap = int(l_idx.shape[0])
+    l_live = jnp.arange(l_cap, dtype=jnp.int32) < left.num_rows
+    r_live = jnp.arange(r_cap, dtype=jnp.int32) < right.num_rows
+    no_ovf = jnp.asarray(False)
+
+    if join_type in ("left_semi", "left_anti"):
+        if join_type == "left_semi":
+            mask = l_live & (l_counts > 0)
+        else:
+            mask = l_live & (l_counts == 0)
+        idx, count = compaction_indices(mask, left.num_rows)
+        # pure row filter of the left side: default caps exact, no overflow
+        return gather_rows(left, idx, count), no_ovf
+
+    if join_type == "inner":
+        live = jnp.arange(pair_cap, dtype=jnp.int32) < n_pairs
+        lcaps = _static_byte_caps(left, growth, out_cap=pair_cap)
+        rcaps = _static_byte_caps(right, growth, out_cap=pair_cap)
+        ovf = _caps_overflow(_needed_bytes(left, l_idx, live), lcaps) | \
+            _caps_overflow(_needed_bytes(right, r_idx, live), rcaps)
+        lg = gather_rows(left, l_idx, n_pairs, out_capacity=pair_cap,
+                         out_byte_caps=lcaps or None)
+        rg = gather_rows(right, r_idx, n_pairs, out_capacity=pair_cap,
+                         out_byte_caps=rcaps or None)
+        return ColumnBatch(out_schema, list(lg.columns) + list(rg.columns),
+                           n_pairs, pair_cap), ovf
+
+    if join_type in ("left", "right", "full"):
+        add_left = join_type in ("left", "full")
+        add_right = join_type in ("right", "full")
+        un_l_mask = l_live & (l_counts == 0) if add_left else \
+            jnp.zeros(l_cap, dtype=jnp.bool_)
+        un_r_mask = r_live & ~r_matched if add_right else \
+            jnp.zeros(r_cap, dtype=jnp.bool_)
+        n_un_l = jnp.sum(un_l_mask).astype(jnp.int32)
+        n_un_r = jnp.sum(un_r_mask).astype(jnp.int32)
+        total = n_pairs + n_un_l + n_un_r
+        out_cap = round_up_capacity(pair_cap + l_cap + r_cap)
+
+        un_l_idx, _ = compaction_indices(un_l_mask, left.num_rows)
+        un_r_idx, _ = compaction_indices(un_r_mask, right.num_rows)
+
+        i = jnp.arange(out_cap, dtype=jnp.int32)
+        in_pairs = i < n_pairs
+        in_un_l = (i >= n_pairs) & (i < n_pairs + n_un_l)
+        li = jnp.where(in_pairs, l_idx[jnp.clip(i, 0, pair_cap - 1)],
+                       un_l_idx[jnp.clip(i - n_pairs, 0, l_cap - 1)])
+        li = jnp.where(in_un_l | in_pairs, li, 0)
+        l_valid = in_pairs | in_un_l
+        ri = jnp.where(in_pairs, r_idx[jnp.clip(i, 0, pair_cap - 1)],
+                       un_r_idx[jnp.clip(i - n_pairs - n_un_l, 0,
+                                         r_cap - 1)])
+        in_un_r = (i >= n_pairs + n_un_l) & (i < n_pairs + n_un_l + n_un_r)
+        ri = jnp.where(in_pairs | in_un_r, ri, 0)
+        r_valid = in_pairs | in_un_r
+
+        live = jnp.arange(out_cap, dtype=jnp.int32) < total
+        # needed = matched pairs' bytes + unmatched rows' bytes; unmatched
+        # rows alone can fill a whole input, so scale by growth + 1.
+        # The needed-bytes mask is `live` alone (matching the gather,
+        # which copies row 0's bytes for null-padded rows) — masking by
+        # validity too would let a truncation slip past the overflow check
+        lcaps = _static_byte_caps(left, growth + 1.0, out_cap=out_cap)
+        rcaps = _static_byte_caps(right, growth + 1.0, out_cap=out_cap)
+        ovf = _caps_overflow(
+            _needed_bytes(left, jnp.where(l_valid, li, 0), live),
+            lcaps) | _caps_overflow(
+            _needed_bytes(right, jnp.where(r_valid, ri, 0), live),
+            rcaps)
+        lg = gather_rows(left, jnp.where(l_valid, li, 0), total,
+                         out_capacity=out_cap, out_byte_caps=lcaps or None)
+        rg = gather_rows(right, jnp.where(r_valid, ri, 0), total,
+                         out_capacity=out_cap, out_byte_caps=rcaps or None)
+        lcols = [type(c)(c.dtype, c.data, c.validity & l_valid, c.offsets)
+                 for c in lg.columns]
+        rcols = [type(c)(c.dtype, c.data, c.validity & r_valid, c.offsets)
+                 for c in rg.columns]
+        return ColumnBatch(out_schema, lcols + rcols, total, out_cap), ovf
+
+    raise ValueError(f"unsupported join type: {join_type}")
+
+
+def hash_join_static(left: ColumnBatch, left_keys: List[DevVal],
+                     right: ColumnBatch, right_keys: List[DevVal],
+                     join_type: str, out_schema: T.Schema,
+                     growth: float = 2.0):
+    """Fully-traced equi-join with capacity-bucketed output sizing (no
+    host sync — the mesh-SPMD fused path).  The pair capacity is the
+    BucketPolicy quantization of ``left.capacity * growth``; residual
+    conditions are NOT supported (they host-sync for byte sizing — the
+    lowering gates on ``condition is None``).  Returns
+    ``(batch, overflow)``: on overflow the caller must discard the batch
+    and rerun the stage host-driven."""
+    pair_cap = round_up_capacity(max(int(left.capacity * growth), 1))
+    l_idx, r_idx, n_pairs, l_counts, r_matched, ovf = join_pairs_static(
+        left_keys, left.num_rows, right_keys, right.num_rows, pair_cap)
+    out, ovf2 = stitch_join_output_static(
+        left, right, l_idx, r_idx, n_pairs, l_counts, r_matched,
+        join_type, out_schema, growth)
+    return out, ovf | ovf2
+
+
 def _string_byte_caps(batch: ColumnBatch, indices, live) -> List[int]:
     """Host-sync sizing of output byte capacities for string columns.
 
@@ -488,8 +712,12 @@ def stitch_join_output(left: ColumnBatch, right: ColumnBatch, l_idx, r_idx,
         li, l_valid, ri, r_valid = stitch_indices(
             l_idx, r_idx, un_l_idx, un_r_idx, n_pairs, n_un_l, n_un_r)
         live = jnp.arange(out_cap, dtype=jnp.int32) < total
-        lcaps = _string_byte_caps(left, li, live & l_valid)
-        rcaps = _string_byte_caps(right, ri, live & r_valid)
+        # caps must count what the gather COPIES, not what stays valid:
+        # null-padded rows gather row 0's bytes (validity masked after),
+        # so size over the zeroed indices with the live mask alone — a
+        # `live & valid` mask undersizes and truncates the last real rows
+        lcaps = _string_byte_caps(left, jnp.where(l_valid, li, 0), live)
+        rcaps = _string_byte_caps(right, jnp.where(r_valid, ri, 0), live)
         # NULL-pad: gather with index 0 for padded side, then mask validity.
         lg = gather_rows(left, jnp.where(l_valid, li, 0), total,
                          out_capacity=out_cap, out_byte_caps=lcaps or None)

@@ -644,8 +644,13 @@ class TpuHashAggregateExec(TpuExec):
         sort variant — correctness never depends on data shape."""
         if not outs or outs[-1].schema is not _HASH_FLAGS_SCHEMA:
             return outs
-        flags = outs.pop()
-        if flags.host_num_rows():
+        # a mesh-sharded stage unshards one flags pseudo-batch PER
+        # device (all trailing — the flags batch is the last program
+        # output) — pop and sum every one of them
+        flagged = 0
+        while outs and outs[-1].schema is _HASH_FLAGS_SCHEMA:
+            flagged += outs.pop().host_num_rows()
+        if flagged:
             self._hash_disabled = True
             ctx.metric(self.op_id, "hashAggFallback").add(1)
             return rerun()
@@ -925,6 +930,68 @@ class TpuShuffledHashJoinExec(TpuExec):
 
     def num_partitions(self, ctx):
         return self.children[0].num_partitions(ctx)
+
+    _FUSABLE_HOWS = ("inner", "left", "right", "full", "left_semi",
+                     "left_anti")
+
+    def pipeline_inline(self, ctx, build):
+        """Mesh-SPMD fusion: lower the join INTO the surrounding
+        shard_map program.  Both input shuffles fuse as in-program
+        all_to_alls over the same key hash, so each shard holds a
+        co-partitioned (left, right) pair — every join type is correct
+        per shard — and the per-shard join runs with STATIC bucketed
+        output sizing (kernels.join.hash_join_static), no host sync for
+        the pair total.  A traced overflow flag rides the program's
+        outputs (parallel.mesh_spmd.note_overflow_flag); when the true
+        output exceeded its bucket the stage transparently reruns
+        host-driven.  Returns None (host path: AQE coalescing, skew
+        splits, broadcast switch, residual conditions) unless both
+        children are rule-matched mesh exchanges."""
+        from spark_rapids_tpu.parallel.exchange import (
+            TpuShuffleExchangeExec,
+        )
+        from spark_rapids_tpu.parallel.partitioning import (
+            match_partition_rules,
+        )
+        from spark_rapids_tpu.plan.pipeline import (
+            concat_static, mesh_build_scope,
+        )
+        scope = mesh_build_scope()
+        if scope is None or self.condition is not None or \
+                self.how not in self._FUSABLE_HOWS:
+            return None
+        # static pre-check BEFORE building any child: a child that would
+        # not fuse must leave this op (not its subtree) the stage source
+        for ch in self.children:
+            if not (isinstance(ch, TpuShuffleExchangeExec) and
+                    ch._mesh_active(ctx) and
+                    match_partition_rules(
+                        type(ch.partitioning).__name__) is not None):
+                return None
+        from spark_rapids_tpu.config import (
+            JOIN_DICT_KEYS_ENABLED, MESH_SPMD_JOIN_GROWTH,
+        )
+        from spark_rapids_tpu.kernels.join import hash_join_static
+        from spark_rapids_tpu.parallel.mesh_spmd import note_overflow_flag
+        growth = MESH_SPMD_JOIN_GROWTH.get(ctx.conf)
+        dict_keys = JOIN_DICT_KEYS_ENABLED.get(ctx.conf)
+        lf = build(self.children[0])
+        rf = build(self.children[1])
+        lsch = self.children[0].output_schema
+        rsch = self.children[1].output_schema
+        scope.joins.append(self)
+
+        def f(args):
+            lb = concat_static(lf(args), lsch)
+            rb = concat_static(rf(args), rsch)
+            lkeys = _eval_join_keys(self.left_keys, lb, dict_keys)
+            rkeys = _eval_join_keys(self.right_keys, rb, dict_keys)
+            out, ovf = hash_join_static(lb, lkeys, rb, rkeys, self.how,
+                                        self.output_schema, growth=growth)
+            note_overflow_flag(ovf)
+            return [out]
+
+        return f
 
     def partitions(self, ctx):
         import itertools
@@ -1390,6 +1457,80 @@ class TpuBroadcastHashJoinExec(TpuExec):
 
     def num_partitions(self, ctx):
         return self.children[0].num_partitions(ctx)
+
+    # planner-legal broadcast combinations (unmatched BUILD rows are never
+    # emitted, so a replicated build joined per shard stays exact)
+    _FUSABLE_HOWS = {
+        "right": ("inner", "left", "left_semi", "left_anti"),
+        "left": ("inner", "right"),
+    }
+
+    def pipeline_inline(self, ctx, build):
+        """Mesh-SPMD fusion: join per shard inside the fused shard_map
+        program with the build side REPLICATED — its stage sources are
+        recorded in ``scope.replicated`` so parallel.mesh_spmd feeds them
+        as PartitionSpec-() globals (every shard sees the full build,
+        like the host path's broadcast handle).  The planner-guaranteed
+        build-side legality (class docstring) means no unmatched build
+        row is ever emitted, so replaying the build on every shard never
+        duplicates output rows.  Output sizing is static-bucketed
+        (hash_join_static) with the same traced overflow -> host-rerun
+        contract as the shuffled join.  Returns None when the build
+        subtree contains an exchange (it would fuse as a collective and
+        SHARD the build) or shares nodes with the stream subtree (shared
+        sources cannot be both replicated and distributed)."""
+        from spark_rapids_tpu.parallel.exchange import (
+            TpuShuffleExchangeExec,
+        )
+        from spark_rapids_tpu.plan.pipeline import (
+            concat_static, mesh_build_scope,
+        )
+        scope = mesh_build_scope()
+        if scope is None or self.condition is not None or \
+                self.how not in self._FUSABLE_HOWS.get(
+                    self.broadcast_side, ()):
+            return None
+
+        bc_nodes = list(self._walk(self.children[1]))
+        if any(isinstance(o, TpuShuffleExchangeExec) for o in bc_nodes):
+            return None
+        if {id(o) for o in bc_nodes} & \
+                {id(o) for o in self._walk(self.children[0])}:
+            return None
+        from spark_rapids_tpu.config import (
+            JOIN_DICT_KEYS_ENABLED, MESH_SPMD_JOIN_GROWTH,
+        )
+        from spark_rapids_tpu.kernels.join import hash_join_static
+        from spark_rapids_tpu.parallel.mesh_spmd import note_overflow_flag
+        growth = MESH_SPMD_JOIN_GROWTH.get(ctx.conf)
+        dict_keys = JOIN_DICT_KEYS_ENABLED.get(ctx.conf)
+        before = len(scope.sources)
+        bf = build(self.children[1])
+        scope.replicated.update(range(before, len(scope.sources)))
+        sf = build(self.children[0])
+        bc_schema = self.children[1].output_schema
+        stream_schema = self.children[0].output_schema
+        scope.joins.append(self)
+
+        def f(args):
+            sb = concat_static(sf(args), stream_schema)
+            bc = concat_static(bf(args), bc_schema)
+            lb, rb = (sb, bc) if self.broadcast_side == "right" \
+                else (bc, sb)
+            lkeys = _eval_join_keys(self.left_keys, lb, dict_keys)
+            rkeys = _eval_join_keys(self.right_keys, rb, dict_keys)
+            out, ovf = hash_join_static(lb, lkeys, rb, rkeys, self.how,
+                                        self.output_schema, growth=growth)
+            note_overflow_flag(ovf)
+            return [out]
+
+        return f
+
+    @staticmethod
+    def _walk(op):
+        yield op
+        for c in op.children:
+            yield from TpuBroadcastHashJoinExec._walk(c)
 
     def _broadcast_handle(self, ctx):
         """Materialize the build side ONCE per query and register it with

@@ -228,20 +228,26 @@ class TpuShuffleExchangeExec(TpuExec):
         program with zero host syncs at the boundary.
 
         Returns None (exchange stays a host-driven stage source) when no
-        mesh build scope is active, or when the partitioning is not
-        mesh-compatible (partitioning.mesh_compatible: range needs an
-        eager host sample pre-pass; single would leave each shard a
-        private "partition 0", breaking global aggregates/limits) —
-        unless mesh.spmd.autoFallback is off, which turns that silent
-        fallback into an error for debugging fusion coverage."""
+        mesh build scope is active, or when the partitioning matches no
+        PartitionSpec rule (partitioning.MESH_PARTITION_RULES: single
+        would leave each shard a private "partition 0", breaking global
+        aggregates/limits) — unless mesh.spmd.autoFallback is off, which
+        turns that silent fallback into an error for debugging fusion
+        coverage.  Range partitioning fuses: its bounds are sampled,
+        pooled (all_gather) and picked INSIDE the program
+        (RangePartitioning.device_bounds_in_program), replacing the eager
+        host prepare() pre-pass."""
         from spark_rapids_tpu.plan.pipeline import (
             concat_static, mesh_build_scope,
         )
         scope = mesh_build_scope()
         if scope is None:
             return None
-        from spark_rapids_tpu.parallel.partitioning import mesh_compatible
-        if not mesh_compatible(self.partitioning):
+        from spark_rapids_tpu.parallel.partitioning import (
+            match_partition_rules,
+        )
+        if match_partition_rules(
+                type(self.partitioning).__name__) is None:
             from spark_rapids_tpu.config import MESH_SPMD_AUTO_FALLBACK
             if not MESH_SPMD_AUTO_FALLBACK.get(ctx.conf):
                 raise RuntimeError(
@@ -259,6 +265,8 @@ class TpuShuffleExchangeExec(TpuExec):
         fns = list(self._input_fns)
         n = ctx.mesh.shape[DATA_AXIS]
         part = _mesh_partitioning(self.partitioning, n)
+        sample_per_shard = _range_sample_limit(ctx) if \
+            isinstance(part, RangePartitioning) else 0
         scope.exchanges.append(self)
 
         def f(args):
@@ -272,7 +280,12 @@ class TpuShuffleExchangeExec(TpuExec):
             b = concat_static(bs, self.output_schema) if len(bs) != 1 \
                 else bs[0]
             d = jax.lax.axis_index(DATA_AXIS)
-            pid = part.device_partition_ids(b, d)
+            if isinstance(part, RangePartitioning):
+                bounds = part.device_bounds_in_program(
+                    b, DATA_AXIS, max(1, sample_per_shard // n))
+                pid = part.device_partition_ids_from_words(b, bounds)
+            else:
+                pid = part.device_partition_ids(b, d)
             return [exchange_batch_collective(
                 b, jnp.asarray(pid, jnp.int32), n)]
 
@@ -405,6 +418,16 @@ class TpuShuffleExchangeExec(TpuExec):
             "exchange", "mesh", self.op_id, t0, t0 + wall_ns,
             bytes=stats.get("payload_bytes", 0), devices=n,
             bytes_per_device=stats.get("bytes_per_device"))
+        if stats.get("encoded_materialized"):
+            # the encoded-corridor gap at mesh boundaries, measured:
+            # dict-encoded columns give up their codes here (the
+            # collective wire format is materialized elements)
+            ctx.metric(self.op_id, "meshEncodedMaterializedBytes").add(
+                stats.get("materialized_bytes", 0))
+            obs_events.emit_instant(
+                "exchange", "mesh_materialize", self.op_id,
+                batches=stats.get("encoded_materialized", 0),
+                bytes=stats.get("materialized_bytes", 0))
         return [iter([b]) for b in out] if out else \
             [iter([]) for _ in range(n)]
 

@@ -565,11 +565,25 @@ def mesh_exchange_batches(mesh: Mesh, local_batches, pids_list,
                 # single-host exchange keeps codes on the wire —
                 # exchange.dictAware — but cross-device pieces would each
                 # need the whole dictionary; see docs/shuffle.md.)
-                from spark_rapids_tpu.kernels.layout import ensure_row_layout
-                b = ensure_row_layout(b)
                 if stats is not None:
+                    # bytes the encoded corridor gives up at this
+                    # boundary: the MATERIALIZED element bytes of the
+                    # encoded columns (host_sizes already fetched them —
+                    # no extra sync), surfaced as the exchange's
+                    # mesh_materialize obs instant
+                    from spark_rapids_tpu.batch import varlen_byte_scales
+                    vs = varlen_byte_scales(schema)
+                    _, totals = sizes[present.index(d)]
+                    enc_flags = [c.codes is not None
+                                 for c in b.columns if c.is_varlen]
+                    stats["materialized_bytes"] = \
+                        stats.get("materialized_bytes", 0) + sum(
+                            int(t) * sc for t, sc, e
+                            in zip(totals, vs, enc_flags) if e)
                     stats["encoded_materialized"] = \
                         stats.get("encoded_materialized", 0) + 1
+                from spark_rapids_tpu.kernels.layout import ensure_row_layout
+                b = ensure_row_layout(b)
             cols, nr, pid = list(b.columns), b.num_rows, pids_list[d]
         moved = jax.device_put((cols, nr, pid), devices[d])
         payloads = pack(*moved)

@@ -54,6 +54,8 @@ lineage (plan/recovery ladder); compiled programs are cached per
 
 from __future__ import annotations
 
+import contextlib
+import threading
 import time
 from collections import OrderedDict
 from typing import List, Optional, Tuple
@@ -203,6 +205,32 @@ def _global_batch(schema, pl, cap: int) -> ColumnBatch:
     return _batch_from_payloads(schema, pl, cap, squeeze=False)
 
 
+_OVERFLOW = threading.local()
+
+
+def note_overflow_flag(flag) -> None:
+    """Trace-time channel from a fused join to the mesh program: a join
+    lowering with static bucketed output sizing calls this with its
+    traced overflow bool; :func:`run_mesh_stage`'s program body collects
+    every flag into one extra program output it checks post-dispatch
+    (the only host read a fused stage pays, and only when a join fused).
+    No-op outside a collecting mesh program body."""
+    sink = getattr(_OVERFLOW, "sink", None)
+    if sink is not None:
+        sink.append(jnp.any(flag))
+
+
+@contextlib.contextmanager
+def _collect_overflow():
+    prev = getattr(_OVERFLOW, "sink", None)
+    sink = []
+    _OVERFLOW.sink = sink
+    try:
+        yield sink
+    finally:
+        _OVERFLOW.sink = prev
+
+
 def run_mesh_stage(root, ctx, variant: str,
                    shrink: bool = True) -> List[ColumnBatch]:
     """Execute a stage whose build fused >=1 exchange as ONE shard_map
@@ -215,7 +243,7 @@ def run_mesh_stage(root, ctx, variant: str,
     n = mesh.shape[DATA_AXIS]
     devices = list(mesh.devices.flat)
     sources, fn = PL._stage_build(root, ctx, variant)
-    exchanges, replicated = root._mesh_stage_info[variant]
+    exchanges, replicated, joins = root._mesh_stage_info[variant]
     mats = PL._materialize_sources(sources, ctx, fuse=False)
 
     sh_rep = NamedSharding(mesh, P())
@@ -284,6 +312,14 @@ def run_mesh_stage(root, ctx, variant: str,
     if not isinstance(cache, dict):
         cache = {}
         root._mesh_programs = cache
+    # per-output schemas, recorded when the program body traces: a stage
+    # fn may emit batches that are NOT root.output_schema (the MXU hash
+    # aggregate's trailing flags pseudo-batch) — rebuilding every output
+    # against the root schema would misparse their payload lists
+    scache = getattr(root, "_mesh_out_schemas", None)
+    if not isinstance(scache, dict):
+        scache = {}
+        root._mesh_out_schemas = scache
     key = (variant, n, DeviceRuntime.generation(), tuple(sig_parts))
     program = cache.get(key)
     if program is None:
@@ -309,10 +345,16 @@ def run_mesh_stage(root, ctx, variant: str,
                             schema2, flat[pos:pos + k], cap, squeeze=True))
                         pos += k
                     args.append(tuple(bs))
-            outs = fn(tuple(args))
+            with _collect_overflow() as ovf_flags:
+                outs = fn(tuple(args))
+            ovf = jnp.zeros(1, jnp.bool_)
+            for flag in ovf_flags:
+                ovf = ovf | jnp.reshape(flag, (1,))
             flat_out = []
+            schemas = []
             for b in outs:
                 b = ensure_row_layout(b)
+                schemas.append(b.schema)
                 pl = []
                 for c in b.columns:
                     if c.offsets is not None:
@@ -323,15 +365,28 @@ def run_mesh_stage(root, ctx, variant: str,
                         pl += [c.data[None], c.validity[None]]
                 pl.append(jnp.asarray(b.num_rows, jnp.int32).reshape(1))
                 flat_out.append(pl)
-            return flat_out
+            scache[key] = schemas
+            return flat_out, ovf
 
         try:
             from jax import shard_map  # jax >= 0.6 top-level export
         except ImportError:  # jax 0.4.x keeps it in experimental
             from jax.experimental.shard_map import shard_map
+        sm_kw = {}
+        if replicated:
+            # the static replication checker mis-tracks lax.scan carries
+            # that mix a replicated build side with sharded probe rows
+            # (jax#scan-carry replication bug); correctness does not
+            # depend on it — specs are verified by plan_verify instead
+            import inspect
+            params = inspect.signature(shard_map).parameters
+            for kw in ("check_rep", "check_vma"):
+                if kw in params:
+                    sm_kw[kw] = False
+                    break
         program = instrumented_jit(
             shard_map(body, mesh=mesh, in_specs=(tuple(in_specs),),
-                      out_specs=P(DATA_AXIS)),
+                      out_specs=P(DATA_AXIS), **sm_kw),
             label=f"meshStage:{root.name}")
         cache[key] = program
 
@@ -340,29 +395,41 @@ def run_mesh_stage(root, ctx, variant: str,
     ctx.metric("pipeline", "meshProgramDispatches").add(1)
     for ex in exchanges:
         ctx.metric(ex.op_id, "meshBoundariesFused").add(1)
+    for j in joins:
+        ctx.metric(j.op_id, "meshJoinsFused").add(1)
     out_schema = root.output_schema
+    overflowed = False
+    results: List[ColumnBatch] = []
     with device_dispatch(ctx, "pipeline", root.name,
                          obs_op=root.op_id) as holder:
-        out_lists = PL._run_oom_guarded(
+        out_lists, ovf_g = PL._run_oom_guarded(
             ctx, lambda: program(tuple(flat_globals)), args=(),
             retryable=True)
+        # the ONLY host read of a fused stage, paid only when a join
+        # fused: did any shard's bucketed join output overflow its
+        # static capacity?  (a [n]-bool fetch after the one dispatch,
+        # not a per-boundary shuffleSync)
+        if joins:
+            overflowed = bool(jax.device_get(ovf_g).any())
+        if overflowed:
+            holder["outputs"] = []
+            out_lists = []
         # one catalog handle per stacked output global, closed right
         # after unsharding: per-shard HBM accounting without exposing a
         # long-lived spill victim that would gather every shard
         cat = DeviceRuntime.get(ctx.conf).catalog
+        out_schemas = scache.get(key) or [out_schema] * len(out_lists)
         handles = [
             cat.register_sharded(
-                _global_batch(out_schema, pl, _out_capacity(out_schema,
-                                                            pl)))
-            for pl in out_lists]
+                _global_batch(sch, pl, _out_capacity(sch, pl)))
+            for sch, pl in zip(out_schemas, out_lists)]
         bytes_per_device = [0] * n
         for h in handles:
             for d, v in enumerate(h.shard_bytes):
                 bytes_per_device[d] += v
         dev_pos = {d: i for i, d in enumerate(devices)}
-        results: List[ColumnBatch] = []
-        for pl in out_lists:
-            cap = _out_capacity(out_schema, pl)
+        for sch, pl in zip(out_schemas, out_lists):
+            cap = _out_capacity(sch, pl)
             per_dev: List[list] = [[] for _ in range(n)]
             for g in pl:
                 for shard in g.addressable_shards:
@@ -370,21 +437,46 @@ def run_mesh_stage(root, ctx, variant: str,
             for d in range(n):
                 arrs = _unshard(per_dev[d])
                 results.append(_batch_from_payloads(
-                    out_schema, arrs, cap, squeeze=False))
+                    sch, arrs, cap, squeeze=False))
         for h in handles:
             h.close()
-        holder["outputs"] = results
+        if not overflowed:
+            holder["outputs"] = results
     obs_events.emit_span(
         "mesh", "program", root.op_id, t0, time.monotonic_ns(),
         devices=n, fused_boundaries=len(exchanges),
-        bytes_per_device=bytes_per_device)
+        fused_joins=len(joins), bytes_per_device=bytes_per_device)
+    if overflowed:
+        # a shard's true join output exceeded its static bucket: the
+        # fused results are invalid — rerun the whole stage host-driven
+        # (the classic host-synced join sizes exactly)
+        ctx.metric("pipeline", "meshFallbacks").add(1)
+        obs_events.emit_instant(
+            "mesh", "join_overflow_fallback", root.op_id,
+            joins=[j.op_id for j in joins])
+        from spark_rapids_tpu.config import MESH_SPMD_AUTO_FALLBACK
+        if not MESH_SPMD_AUTO_FALLBACK.get(ctx.conf):
+            raise RuntimeError(
+                f"{root.name}: fused join output overflowed its static "
+                "capacity bucket and "
+                "spark.rapids.sql.tpu.mesh.spmd.autoFallback is disabled "
+                "(raise mesh.spmd.join.growthFactor or enable "
+                "autoFallback)")
+        return PL.run_stage_unfused(root, ctx, variant, shrink=shrink)
     # sharding invariants for analysis/plan_verify.check_mesh_sharding:
     # declared specs on every program input/output, boundary flips only
-    # at the recorded reshard (exchange) ops, no donation under sharding
+    # at the recorded reshard (exchange) ops — or, for a stage fused
+    # around a broadcast join only, at no boundary at all — and no
+    # donation under sharding.  ``replicated`` lists the input leaf
+    # indices that entered with an all-None (replicated) spec.
+    rep_leaves = [i for i, sp in enumerate(in_specs)
+                  if all(ax is None for ax in tuple(sp))]
     root._mesh_partition_specs = {
         "in_specs": list(in_specs),
         "out_specs": [P(DATA_AXIS)] * sum(len(pl) for pl in out_lists),
         "reshards": [ex.op_id for ex in exchanges],
+        "joins": [j.op_id for j in joins],
+        "replicated": rep_leaves,
         "dmask": (False,) * len(sources),
     }
     if shrink:

@@ -16,6 +16,7 @@ See docs/compatibility.md.
 from __future__ import annotations
 
 import dataclasses
+import re
 from typing import List, Optional
 
 import jax.numpy as jnp
@@ -87,21 +88,48 @@ class RoundRobinPartitioning(Partitioning):
             % np.int32(self.num_partitions)
 
 
+#: Declarative regex -> PartitionSpec rules mapping a partitioning's class
+#: name to the sharding its exchanged data carries inside a mesh-SPMD
+#: program (docs/mesh.md "PartitionSpec rules").  ``("data",)`` means
+#: row-sharded over the mesh data axis (the axis name matches
+#: mesh_shuffle.DATA_AXIS); ``None`` means the strategy cannot lower into
+#: the program and the exchange stays host-driven.  Both the lowering
+#: (exchange._mesh_spmd_inline) and the verifier
+#: (analysis.plan_verify.check_mesh_sharding) consume THIS table, so a
+#: strategy cannot fuse under one and be rejected by the other.
+#:
+#: Hash / round-robin / range all shard by rows: their pid computations
+#: are pure traced jnp over (batch, axis_index), with range bounds
+#: sampled + sorted + picked in-program (device_bounds_in_program).
+#: Single stays None: fusing it would leave each shard holding
+#: "partition 0" locally, so a downstream global aggregate or limit
+#: would run once PER SHARD (n rows where the contract is 1) —
+#: single-partition consumers depend on seeing ONE merged partition,
+#: which only the host-driven path provides.
+MESH_PARTITION_RULES = (
+    (r"^HashPartitioning", ("data",)),
+    (r"^RoundRobinPartitioning", ("data",)),
+    (r"^RangePartitioning", ("data",)),
+    (r"^SinglePartitioning", None),
+)
+
+
+def match_partition_rules(name: str, rules=None):
+    """First rule whose regex matches ``name`` (re.search) -> its
+    PartitionSpec axis tuple, or None when no rule matches / the matched
+    rule is an explicit None (both mean: not mesh-fusable)."""
+    for pat, spec in (MESH_PARTITION_RULES if rules is None else rules):
+        if re.search(pat, name):
+            return spec
+    return None
+
+
 def mesh_compatible(p: Partitioning) -> bool:
     """Whether ``p``'s pid computation can lower INTO a mesh-SPMD
-    shard_map program (the per-operator partitioning requirement the
-    exchange threads into whole-stage lowering — see docs/mesh.md).
-
-    Hash and round-robin qualify: their device_partition_ids are pure
-    traced jnp over (batch, part_index), and ``lax.axis_index`` supplies
-    part_index in-program.  Range does NOT — its bounds come from an
-    eager host-side sample pre-pass (:meth:`RangePartitioning.prepare`),
-    a sync by construction.  Single does not either: fusing it would
-    leave each shard holding "partition 0" locally, so a downstream
-    global aggregate or limit would run once PER SHARD (n rows where the
-    contract is 1) — single-partition consumers depend on seeing ONE
-    merged partition, which only the host-driven path provides."""
-    return isinstance(p, (HashPartitioning, RoundRobinPartitioning))
+    shard_map program — a pure lookup of :data:`MESH_PARTITION_RULES`
+    by class name (see the table's docstring for the rationale per
+    strategy)."""
+    return match_partition_rules(type(p).__name__) is not None
 
 
 class RangePartitioning(Partitioning):
@@ -241,6 +269,52 @@ class RangePartitioning(Partitioning):
             gt = gt | (eq & (w[:, None] > bw[None, :]))
             eq = eq & (w[:, None] == bw[None, :])
         return jnp.sum(gt, axis=1).astype(jnp.int32)
+
+    def device_bounds_in_program(self, batch: ColumnBatch, axis_name: str,
+                                 sample_per_shard: int) -> tuple:
+        """Range bounds computed INSIDE a shard_map program — the fused
+        replacement for the eager host :meth:`prepare` sample pre-pass.
+
+        Each shard contributes its first ``sample_per_shard`` live rows'
+        encoded key words (padding rows mask to an all-ones sentinel whose
+        leading null-rank word no real row can produce, so they sort
+        strictly last); an ``all_gather`` over the mesh data axis pools
+        the samples, one multi-word ``lax.sort`` orders them, and bound i
+        is the pooled sample at ``(i * L) // n`` clipped to the live
+        count L — the same index formula as the host :meth:`prepare`.
+
+        The bound CHOICE differs from the host sample's (different rows
+        sampled), but the partitioned result does not: partition ids use
+        strict lexicographic compares, so equal keys never split across
+        partitions and a range-partitioned sort's output is identical for
+        any bound choice.  Returns traced bound word arrays shaped like
+        :meth:`encode_bounds_device`'s, for
+        :meth:`device_partition_ids_from_words`."""
+        import jax
+        from spark_rapids_tpu.exprs.base import DevVal
+        from spark_rapids_tpu.kernels.sortkeys import encode_sort_keys
+        n = self.num_partitions
+        if n <= 1:
+            return ()
+        vals = [DevVal.from_column(batch.columns[i])
+                for i in self.key_ordinals]
+        ascs = [o.ascending for o in self.orders]
+        nfs = [o.nulls_first for o in self.orders]
+        words = encode_sort_keys(vals, ascs, nfs, batch.num_rows,
+                                 liveness=False)
+        s_cap = min(batch.capacity, max(int(sample_per_shard), 1))
+        live = jnp.arange(s_cap, dtype=jnp.int32) < batch.num_rows
+        sentinel = ~jnp.uint32(0)
+        swords = [jnp.where(live, w[:s_cap], sentinel) for w in words]
+        gwords = [jax.lax.all_gather(w, axis_name).reshape(-1)
+                  for w in swords]
+        ordered = jax.lax.sort(tuple(gwords), num_keys=len(gwords),
+                               is_stable=True)
+        length = jax.lax.psum(jnp.sum(live.astype(jnp.int32)), axis_name)
+        idxs = jnp.clip(
+            (jnp.arange(1, n, dtype=jnp.int32) * length) // n,
+            0, jnp.maximum(length - 1, 0))
+        return tuple(w[idxs] for w in ordered)
 
     def _encode_bound(self, bound: tuple) -> list:
         """Encode one host bound row with the same word scheme as

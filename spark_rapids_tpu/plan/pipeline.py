@@ -40,6 +40,7 @@ Every stage program dispatch is counted and device-timed
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from typing import Callable, List, Optional, Tuple
 
@@ -116,16 +117,19 @@ class MeshBuildScope:
 
     ``TpuShuffleExchangeExec.pipeline_inline`` appends itself to
     ``exchanges`` when it fuses as an in-program all_to_all instead of
-    becoming a host-driven stage source; ``TpuBroadcastHashJoinExec``
-    records in ``replicated`` the source indices its build side added, so
-    parallel.mesh_spmd feeds those sources as PartitionSpec-()
-    replicated globals.  ``sources`` aliases the stage's live source
-    list, letting ops observe indices as ``build_pipeline`` appends."""
+    becoming a host-driven stage source; join execs append themselves to
+    ``joins`` when they lower per-shard with static bucketed output
+    sizing, and ``TpuBroadcastHashJoinExec`` records in ``replicated``
+    the source indices its build side added, so parallel.mesh_spmd feeds
+    those sources as PartitionSpec-() replicated globals.  ``sources``
+    aliases the stage's live source list, letting ops observe indices as
+    ``build_pipeline`` appends."""
 
     def __init__(self, sources: List[PhysicalOp]):
         self.sources = sources
         self.exchanges: List[PhysicalOp] = []
         self.replicated: set = set()
+        self.joins: List[PhysicalOp] = []
 
 
 _MESH_BUILD = threading.local()
@@ -135,8 +139,25 @@ def mesh_build_scope() -> Optional[MeshBuildScope]:
     """The innermost active mesh-SPMD build scope; None outside a stage
     build or when SPMD fusion is off — ops treat None as 'do not
     mesh-fuse', which routes exchanges to the host-driven mesh path."""
+    if getattr(_MESH_BUILD, "disabled", False):
+        return None
     stack = getattr(_MESH_BUILD, "stack", None)
     return stack[-1] if stack else None
+
+
+@contextlib.contextmanager
+def mesh_fusion_disabled():
+    """Scoped off-switch for mesh-SPMD fusion: while active,
+    :func:`mesh_build_scope` reports no scope, so every exchange and
+    join lowers host-driven.  The bucketed-join overflow fallback
+    rebuilds an overflowed stage under this to get the classic
+    host-synced plan (see :func:`run_stage_unfused`)."""
+    prev = getattr(_MESH_BUILD, "disabled", False)
+    _MESH_BUILD.disabled = True
+    try:
+        yield
+    finally:
+        _MESH_BUILD.disabled = prev
 
 
 def _mesh_scoped_build(root: PhysicalOp, ctx: ExecContext,
@@ -274,6 +295,25 @@ def _apply_shrink(outs: List[ColumnBatch], spec: tuple, ctx: ExecContext,
     fails fast on OOM — its inputs are consumed at dispatch."""
     caps = tuple(c for c, _ in spec)
     bcapss = tuple(bc for _, bc in spec)
+    devs = set()
+    for b in outs:
+        for leaf in jax.tree_util.tree_leaves(b):
+            get_devs = getattr(leaf, "devices", None)
+            if callable(get_devs):
+                devs.update(get_devs())
+    if len(devs) > 1:
+        # mesh-stage outputs land one batch per mesh device: the gather
+        # must dispatch per batch (one jit over the tuple would be an
+        # illegal cross-device program, and colocating would drag every
+        # shard onto one device).  No donation — per-batch signatures
+        # would fragment the donate cache
+        per_batch = lambda: [  # noqa: E731
+            _shrink_jit((b,), (cap,), (bcaps,))[0]
+            for b, cap, bcaps in zip(outs, caps, bcapss)]
+        if guard:
+            return _run_oom_guarded(ctx, per_batch, (outs,),
+                                    retryable=True)
+        return per_batch()
     jit = _shrink_jit_donate if _donation_enabled(ctx) else _shrink_jit
     if jit is _shrink_jit_donate:
         leaves = jax.tree_util.tree_leaves(tuple(outs))
@@ -392,13 +432,14 @@ def _stage_build(root: PhysicalOp, ctx: ExecContext, variant: str):
     if variant not in cache:
         sources: List[PhysicalOp] = []
         fn, scope = _mesh_scoped_build(root, ctx, sources)
-        if scope is not None and scope.exchanges:
+        if scope is not None and (scope.exchanges or scope.joins):
             minfo = getattr(root, "_mesh_stage_info", None)
             if not isinstance(minfo, dict):
                 minfo = {}
                 root._mesh_stage_info = minfo
             minfo[variant] = (list(scope.exchanges),
-                              frozenset(scope.replicated))
+                              frozenset(scope.replicated),
+                              list(scope.joins))
         cache[variant] = (sources, fn)
     return cache[variant]
 
@@ -497,14 +538,14 @@ def _run_stage(root: PhysicalOp, ctx: ExecContext,
     ``shrink=False`` hands raw outputs to a tail-fusing consumer."""
     variant_fn = getattr(root, "stage_variant", None)
     variant = variant_fn(ctx) if variant_fn is not None else "default"
-    fuse = _fuse_tail_enabled(ctx)
     sources, _fn = _stage_build(root, ctx, variant)
     minfo = getattr(root, "_mesh_stage_info", None)
     if isinstance(minfo, dict) and variant in minfo:
         # the build fused at least one exchange as an in-program
-        # all_to_all: this stage MUST run as a mesh-sharded shard_map
-        # program — the single-device path below would trace
-        # lax.axis_index with no mesh axis bound
+        # all_to_all (or a join as a per-shard static kernel): this
+        # stage MUST run as a mesh-sharded shard_map program — the
+        # single-device path below would trace lax.axis_index with no
+        # mesh axis bound
         from spark_rapids_tpu.parallel.mesh_spmd import run_mesh_stage
 
         def dispatch_mesh(v: str) -> List[ColumnBatch]:
@@ -520,6 +561,28 @@ def _run_stage(root: PhysicalOp, ctx: ExecContext,
 
             outs = post(ctx, outs, rerun_mesh)
         return outs
+    return _run_stage_host(root, ctx, variant, sources, shrink)
+
+
+def run_stage_unfused(root: PhysicalOp, ctx: ExecContext, variant: str,
+                      shrink: bool = True) -> List[ColumnBatch]:
+    """Host-driven rerun of a fused mesh stage (the bucketed-join
+    overflow fallback, parallel.mesh_spmd): rebuild the stage with mesh
+    fusion disabled under a distinct ``nomesh:`` variant key — the
+    unfused build/program caches never collide with the fused ones and
+    the minfo lookup above misses — then dispatch through the normal
+    host path (joins revert to the host-synced two-phase kernel)."""
+    v = "nomesh:" + variant
+    with mesh_fusion_disabled():
+        sources, _fn = _stage_build(root, ctx, v)
+    return _run_stage_host(root, ctx, v, sources, shrink, unfused=True)
+
+
+def _run_stage_host(root: PhysicalOp, ctx: ExecContext, variant: str,
+                    sources: List[PhysicalOp], shrink: bool,
+                    unfused: bool = False) -> List[ColumnBatch]:
+    variant_fn = getattr(root, "stage_variant", None)
+    fuse = _fuse_tail_enabled(ctx)
     mats = _materialize_sources(sources, ctx, fuse)
     args = tuple(tuple(bs) for bs, _, _ in mats)
     spec = tuple(sp for _, sp, _ in mats) if fuse else None
@@ -557,6 +620,10 @@ def _run_stage(root: PhysicalOp, ctx: ExecContext,
             # the op flipped its variant (e.g. hash -> exact sort);
             # re-execute on the SAME materialized source batches
             v2 = variant_fn(ctx) if variant_fn is not None else "default"
+            if unfused:
+                v2 = "nomesh:" + v2
+                with mesh_fusion_disabled():
+                    _stage_build(root, ctx, v2)
             return dispatch(v2)
 
         outs = post(ctx, outs, rerun)

@@ -355,6 +355,22 @@ class TpuSparkSession:
         frame.last_metrics["meshBoundariesFused"] = sum(
             ms["meshBoundariesFused"].value for ms in ctx.metrics.values()
             if "meshBoundariesFused" in ms)
+        # mesh-SPMD v2: joins compiled INTO fused stage programs (static
+        # bucketed output sizing, no host sync), stages that overflowed a
+        # bucket and transparently reran host-driven, and the string
+        # bytes mesh exchanges materialized out of dictionary encoding
+        # (the wire moves decoded rows — the give-up side of the scan's
+        # dict corridor at mesh boundaries)
+        frame.last_metrics["meshJoinsFused"] = sum(
+            ms["meshJoinsFused"].value for ms in ctx.metrics.values()
+            if "meshJoinsFused" in ms)
+        frame.last_metrics["meshFallbacks"] = sum(
+            ms["meshFallbacks"].value for ms in ctx.metrics.values()
+            if "meshFallbacks" in ms)
+        frame.last_metrics["meshEncodedMaterializedBytes"] = sum(
+            ms["meshEncodedMaterializedBytes"].value
+            for ms in ctx.metrics.values()
+            if "meshEncodedMaterializedBytes" in ms)
         _mesh = self._shuffle_mesh()
         frame.last_metrics["meshBackend"] = (
             str(next(iter(_mesh.devices.flat)).platform)

@@ -146,3 +146,25 @@ def test_cross_join():
             exp["s"].append(left["s"][1][i])
             exp["b"].append(right["b"][1][j])
     assert_batches_equal(exp, got, ignore_order=True)
+
+
+def test_outer_join_string_caps_count_copied_bytes():
+    # Regression: null-padded outer rows gather row 0's string bytes
+    # (validity is masked after the copy), so byte caps sized over
+    # `live & valid` undersized the output buffer and truncated the
+    # LAST real string.  Caps must count what the gather copies.
+    n = 64
+    left = {"k": (T.INT, list(range(n))),
+            "s": (T.STRING, ["pad-string-%02d" % i for i in range(n)])}
+    right = {"rk": (T.INT, [1, 2, 999]),
+             "rs": (T.STRING,
+                    ["a-rather-long-anchor-string-0000", "b", "missing"])}
+    lb, rb = make_batch(left), make_batch(right)
+    sch = T.Schema([("k", T.INT), ("s", T.STRING),
+                    ("rk", T.INT), ("rs", T.STRING)])
+    got = device_to_host(hash_join(
+        lb, [DevVal.from_column(lb.column("k"))],
+        rb, [DevVal.from_column(rb.column("rk"))], "full", sch)).to_pydict()
+    exp = join_oracle(left, right, ["k"], ["rk"], "full")
+    assert_batches_equal(exp, got, ignore_order=True)
+    assert "missing" in got["rs"]

@@ -72,8 +72,12 @@ def _people_df(sess, n=500, parts=5):
 
 
 def _assert_mesh_used(sess):
+    # host-driven exchanges count meshExchanges; with mesh SPMD (the
+    # default) the exchange instead fuses into a shard_map program and
+    # counts meshBoundariesFused — either proves rows moved over the mesh
     ops = [op for op, ms in sess.last_metrics.items()
-           if isinstance(ms, dict) and ms.get("meshExchanges")]
+           if isinstance(ms, dict) and (ms.get("meshExchanges") or
+                                        ms.get("meshBoundariesFused"))]
     assert ops, f"no mesh exchange ran: {sess.last_metrics}"
 
 

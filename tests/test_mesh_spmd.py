@@ -18,8 +18,12 @@ from spark_rapids_tpu import types as T
 from tests.compare import assert_tpu_cpu_equal, tpu_session
 from tests.test_mesh_shuffle import MESH_CONFS
 
+# spmd is the DEFAULT since mesh SPMD v2 — SPMD_CONFS keeps the explicit
+# opt-in spelling, SPMD_OFF_CONFS pins the host-driven mesh path
 SPMD_CONFS = {**MESH_CONFS,
               "spark.rapids.sql.tpu.mesh.spmd.enabled": True}
+SPMD_OFF_CONFS = {**MESH_CONFS,
+                  "spark.rapids.sql.tpu.mesh.spmd.enabled": False}
 
 
 def _people_df(sess, n=400, parts=5):
@@ -43,7 +47,7 @@ def _spmd_vs_hostdriven(build):
     program must be BIT-identical to the host-driven mesh path (same
     collective, same row placement — docs/mesh.md's parity contract)."""
     on = tpu_session(**SPMD_CONFS)
-    off = tpu_session(**MESH_CONFS)
+    off = tpu_session(**SPMD_OFF_CONFS)
     rows_on = sorted(build(on).collect(), key=repr)
     rows_off = sorted(build(off).collect(), key=repr)
     assert rows_on == rows_off, (rows_on[:5], rows_off[:5])
@@ -91,20 +95,31 @@ def test_spmd_fused_metrics():
 
 
 def test_spmd_off_reports_zero_fusion():
-    s = tpu_session(**MESH_CONFS)
+    s = tpu_session(**SPMD_OFF_CONFS)
     _groupby(s).collect()
     m = s.last_metrics
     assert m["meshProgramDispatches"] == 0, m
     assert m["meshBoundariesFused"] == 0, m
 
 
-# -- fallback ----------------------------------------------------------------
+def test_spmd_default_on():
+    """Mesh SPMD v2 flips the default: a bare mesh session fuses without
+    anyone setting mesh.spmd.enabled."""
+    s = tpu_session(**MESH_CONFS)
+    _groupby(s).collect()
+    m = s.last_metrics
+    assert m["meshProgramDispatches"] >= 1, m
+    assert m["meshFallbacks"] == 0, m
 
 
-def test_spmd_range_sort_falls_back_with_parity():
-    """Range partitioning needs an eager host sample (prepare()) so the
-    sort's exchange stays host-driven — no fused program — while the
-    query result keeps total order and CPU parity."""
+# -- range partitioning fuses ------------------------------------------------
+
+
+def test_spmd_range_sort_fuses_with_parity():
+    """Mesh SPMD v2: range bounds are sampled, pooled (all_gather) and
+    picked INSIDE the program (RangePartitioning.device_bounds_in_program)
+    — the sort's exchange fuses instead of host-driving an eager
+    prepare() sample, while the query keeps total order and CPU parity."""
     def build(s):
         return _people_df(s, n=300).sort(
             F.col("age").asc(), F.col("name").asc())
@@ -112,13 +127,27 @@ def test_spmd_range_sort_falls_back_with_parity():
                          confs=SPMD_CONFS)
     s = tpu_session(**SPMD_CONFS)
     build(s).collect()
-    assert s.last_metrics["meshProgramDispatches"] == 0, s.last_metrics
+    assert s.last_metrics["meshProgramDispatches"] >= 1, s.last_metrics
+    _spmd_vs_hostdriven(build)
+
+
+# -- fallback ----------------------------------------------------------------
+
+
+def test_spmd_single_partition_falls_back_with_parity():
+    """SinglePartitioning matches no PartitionSpec rule (each shard would
+    see a private 'partition 0'): a keyless global aggregate's exchange
+    stays host-driven with parity intact."""
+    def build(s):
+        return _people_df(s, n=200).agg(F.sum(F.col("age")),
+                                        F.count(F.col("score")))
+    assert_tpu_cpu_equal(build, approx=True, confs=SPMD_CONFS)
 
 
 def test_spmd_autofallback_disabled_raises():
     s = tpu_session(**SPMD_CONFS, **{
         "spark.rapids.sql.tpu.mesh.spmd.autoFallback": False})
-    q = _people_df(s, n=100).sort(F.col("age").asc())
+    q = _people_df(s, n=100).agg(F.sum(F.col("age")))
     with pytest.raises(RuntimeError, match="mesh-SPMD compatible"):
         q.collect()
 
