@@ -818,6 +818,84 @@ def time_mesh():
     return curve, ratio, backend, join_curve, join_fused, fallbacks
 
 
+def time_pallas():
+    """Pallas kernel-tier lane (kernels.pallas_tier): the conf-enabled
+    kernel list, each kernel's interpret-mode wall vs its XLA fallback on
+    identical micro inputs (informational on CPU — interpret mode
+    emulates the kernel program, so the ratio measures the emulation
+    cost, not the TPU win; the chip run reports the real speedups), and
+    the fallback count a default-conf run pays on this backend (every
+    engaged kernel falls back off-TPU; 0 on a real TPU).  Folds in the
+    old benchmarks/pallas_strings_bench.py contains-scan shape."""
+    import jax
+
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.batch import HostBatch, host_to_device
+    from spark_rapids_tpu.config import RapidsConf
+    from spark_rapids_tpu.exprs import strings as S
+    from spark_rapids_tpu.exprs.base import DevVal
+    from spark_rapids_tpu.kernels import layout as KL
+    from spark_rapids_tpu.kernels import pallas_tier as PT
+    from spark_rapids_tpu.kernels.join import join_pairs_static
+
+    enabled = [spec.name for spec in PT.registered()
+               if bool(spec.entry.get(RapidsConf()))]
+
+    rng = np.random.RandomState(3)
+    n = 512
+    alphabet = list("abnexzle")
+    strs = ["".join(rng.choice(alphabet, rng.randint(0, 16)))
+            for _ in range(n)]
+    batch = host_to_device(HostBatch.from_pydict({
+        "k": (T.INT, rng.randint(0, 64, n).astype(np.int32).tolist()),
+        "s": (T.STRING, strs),
+    }))
+    kcol, scol = batch.columns
+    kval = DevVal(kcol.dtype, kcol.data, kcol.validity, kcol.offsets)
+    sval = DevVal(scol.dtype, scol.data, scol.validity, scol.offsets)
+
+    workloads = {
+        "strings": lambda: S._rows_with_match(sval, b"ab"),
+        "stringHash": lambda: S.string_hash2(sval),
+        "gatherScatter": lambda: KL.concat_kway(
+            [batch, batch], 2 * batch.capacity),
+        "joinProbe": lambda: join_pairs_static(
+            [kval], batch.num_rows, [kval], batch.num_rows, 8192),
+    }
+    all_off = {spec.entry.key: False for spec in PT.registered()}
+
+    def wall(fn, conf):
+        PT.configure(conf)
+        try:
+            jax.block_until_ready(fn())  # warm (compile/trace)
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn())
+            return time.perf_counter() - t0
+        finally:
+            PT.configure(None)
+
+    speedup = {}
+    for name, fn in workloads.items():
+        on = dict(all_off)
+        on[PT._KERNELS[name].entry.key] = True
+        on["spark.rapids.sql.tpu.pallas.interpret"] = True
+        xla_s = wall(fn, RapidsConf(all_off))
+        pal_s = wall(fn, RapidsConf(on))
+        speedup[name] = round(xla_s / pal_s, 3) if pal_s > 0 else 0.0
+
+    # fallback economics: default confs (kernels on, interpret off) on
+    # THIS backend — each engaged kernel decision off-TPU is one fallback
+    PT.configure(RapidsConf())
+    try:
+        fb0 = PT.fallback_count()
+        jax.block_until_ready(S._rows_with_match(sval, b"zq"))
+        jax.block_until_ready(S.string_hash2(sval))
+        fallbacks = PT.fallback_count() - fb0
+    finally:
+        PT.configure(None)
+    return enabled, speedup, fallbacks
+
+
 def main():
     try:
         platform = wait_for_backend()
@@ -869,6 +947,7 @@ def main():
     history_speedup, history_hits, history_alerts = time_history()
     (mesh_curve, mesh_ratio, mesh_backend, mesh_join_curve,
      mesh_join_fused, mesh_fallbacks) = time_mesh()
+    pallas_enabled, pallas_speedup, pallas_fallbacks = time_pallas()
 
     data_bytes = ROWS * _bytes_per_row(data)
     device_s = tpu_econ["device_ms"] / 1e3
@@ -1001,6 +1080,14 @@ def main():
         "mesh_join_fused": mesh_join_fused,
         "mesh_join_rows_per_sec_by_devices": mesh_join_curve,
         "mesh_fallback_count": mesh_fallbacks,
+        # pallas kernel-tier lane (kernels.pallas_tier): which kernels
+        # the default confs enable, per-kernel XLA-vs-pallas wall ratio
+        # (interpret-mode emulation on CPU — informational; the chip run
+        # reports the real win), and the fallback count default confs
+        # pay on this backend (0 on a real TPU)
+        "pallas_kernels_enabled": pallas_enabled,
+        "pallas_speedup_by_kernel": pallas_speedup,
+        "pallas_fallback_count": pallas_fallbacks,
         "platform": platform,
         "scan_rows_per_sec": round(SCAN_ROWS / scan_tpu, 1),
         "scan_vs_baseline": round(scan_cpu / scan_tpu, 3),

@@ -81,6 +81,8 @@ for key in ("value", "donated_bytes", "h2d_gb_per_sec", "d2h_gb_per_sec",
             "mesh_spmd_vs_hostdriven", "mesh_backend",
             "mesh_join_fused", "mesh_join_rows_per_sec_by_devices",
             "mesh_fallback_count",
+            "pallas_kernels_enabled", "pallas_speedup_by_kernel",
+            "pallas_fallback_count",
             "history_warm_speedup", "fragment_cache_hits",
             "telemetry_overhead_pct", "critpath_top_site",
             "regression_alerts",
@@ -116,6 +118,16 @@ assert isinstance(j["mesh_join_rows_per_sec_by_devices"], dict), j
 assert j["mesh_fallback_count"] == 0, j
 assert j["fragment_cache_hits"] > 0, j
 assert j["history_warm_speedup"] > 0, j
+# pallas kernel-tier lane gates: all four kernels conf-enabled by
+# default, every kernel measured, and on a non-TPU backend the
+# default-conf probe must pay (and count) its fallbacks
+assert sorted(j["pallas_kernels_enabled"]) == [
+    "gatherScatter", "joinProbe", "stringHash", "strings"], j
+assert isinstance(j["pallas_speedup_by_kernel"], dict) and \
+    sorted(j["pallas_speedup_by_kernel"]) == [
+        "gatherScatter", "joinProbe", "stringHash", "strings"], j
+assert all(v > 0 for v in j["pallas_speedup_by_kernel"].values()), j
+assert j["pallas_fallback_count"] >= 1, j
 # fused-vs-host-driven ratio is recorded, NOT gated: CPU virtual devices
 # emulate ICI through host collectives, so the ratio is informational
 print("mesh spmd vs host-driven (informational):",
@@ -584,6 +596,79 @@ assert s.runtime.semaphore.held_depth() == 0
 print("mesh fused-join fault smoke ok:", {k: m[k] for k in (
     "retryCount", "faultsInjected", "deviceLostCount",
     "meshJoinsFused", "meshProgramDispatches")})
+PY
+
+echo "== pallas kernel-tier smoke (interpret mode): one query per kernel"
+echo "   family with the kernel forced on, bit-identical rows vs the"
+echo "   kernel-off XLA run, zero fallbacks and held_depth == 0; plus the"
+echo "   mesh fused join with the probe kernel on keeps shuffleSyncs == 0"
+python - << 'PY'
+import os
+
+# same virtual-device trick as tests/conftest.py: the mesh leg below
+# needs a multi-device mesh even on a single-CPU host
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+from spark_rapids_tpu.config import RapidsConf
+from spark_rapids_tpu.session import TpuSparkSession
+
+PALLAS_ON = {
+    "spark.rapids.sql.tpu.pallas.strings.enabled": True,
+    "spark.rapids.sql.tpu.pallas.gatherScatter.enabled": True,
+    "spark.rapids.sql.tpu.pallas.joinProbe.enabled": True,
+    "spark.rapids.sql.tpu.pallas.stringHash.enabled": True,
+    "spark.rapids.sql.tpu.pallas.interpret": True,
+}
+PALLAS_OFF = {k: False for k in PALLAS_ON}
+BASE = {
+    "spark.rapids.sql.enabled": True,
+    "spark.sql.autoBroadcastJoinThreshold": 0,
+}
+NAMES = ["ace", "bog", "cab", "dim", "", "abacus", "zebra", "cabal"]
+LEFT = {"name": [NAMES[i % len(NAMES)] for i in range(4096)],
+        "v": list(range(4096))}
+RIGHT = {"name": list(dict.fromkeys(NAMES)),
+         "w": [i * 7 for i in range(len(dict.fromkeys(NAMES)))]}
+
+def run(s):
+    # string-key join (joinProbe + stringHash), contains filter
+    # (strings), multi-partition concat on collect (gatherScatter)
+    left = s.create_dataframe(LEFT, num_partitions=4)
+    right = s.create_dataframe(RIGHT, num_partitions=2)
+    df = left.join(right, on="name", how="inner")
+    return sorted(map(str, df.filter(df["name"].contains("ab")).collect()))
+
+off = TpuSparkSession(RapidsConf({**BASE, **PALLAS_OFF}))
+want = run(off)
+assert want, "smoke query returned no rows"
+
+on = TpuSparkSession(RapidsConf({**BASE, **PALLAS_ON}))
+got = run(on)
+assert got == want, f"pallas parity diverged:\n{got[:5]}\n{want[:5]}"
+m = on.last_metrics
+# interpret mode engages every kernel: nothing may have fallen back
+assert m["pallasFallbackCount"] == 0, m
+assert on.runtime.semaphore.held_depth() == 0
+
+# mesh fused join with the probe kernel on: the join still compiles
+# INTO the fused shard_map program — no host-driven shuffle syncs
+mesh = TpuSparkSession(RapidsConf({
+    **BASE, **PALLAS_ON, "spark.rapids.shuffle.ici.enabled": True}))
+got_mesh = run(mesh)
+assert got_mesh == want, \
+    f"mesh+pallas parity diverged:\n{got_mesh[:5]}\n{want[:5]}"
+mm = mesh.last_metrics
+assert mm["meshJoinsFused"] >= 1, mm
+assert mm["shuffleSyncs"] == 0, mm
+assert mm["pallasFallbackCount"] == 0, mm
+assert mesh.runtime.semaphore.held_depth() == 0
+print("pallas kernel-tier smoke ok:", {
+    "rows": len(got), "pallasFallbackCount": m["pallasFallbackCount"],
+    "meshJoinsFused": mm["meshJoinsFused"],
+    "shuffleSyncs": mm["shuffleSyncs"]})
 PY
 
 echo "== adaptive smoke: skewed join coalesces with bit-identical rows"

@@ -539,6 +539,43 @@ class MetricsKeySyncRule(ProjectRule):
                     self.severity)
 
 
+class PallasKernelTierRule(Rule):
+    """R9: every ``pl.pallas_call`` lives in the kernel tier.
+
+    A bare ``pallas_call`` outside ``kernels/pallas_tier.py`` /
+    ``kernels/pallas_strings.py`` bypasses the tier's contract: no conf
+    gate, no TPU/interpret backend predicate, no automatic bit-identical
+    XLA fallback, no ``pallas`` obs span for rapidsprof, and no
+    ``pallasFallbackCount`` accounting — a kernel that fails to lower
+    then kills the query instead of degrading.
+    """
+
+    id = "R9"
+    name = "pallas-kernel-tier"
+    description = ("pl.pallas_call outside the registered kernel tier "
+                   "(kernels/pallas_tier.py, kernels/pallas_strings.py)")
+
+    ALLOWED_FILES = (
+        "spark_rapids_tpu/kernels/pallas_tier.py",
+        "spark_rapids_tpu/kernels/pallas_strings.py",
+    )
+
+    def check(self, sf: SourceFile) -> Iterator[Finding]:
+        if sf.path in self.ALLOWED_FILES:
+            return
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func) or ""
+            if name == "pallas_call" or name.endswith(".pallas_call"):
+                yield self.finding(
+                    sf, node,
+                    f"`{name}` outside the kernel tier: route through "
+                    "kernels.pallas_tier.run (conf gate, backend "
+                    "predicate, bit-identical XLA fallback, `pallas` obs "
+                    "span, pallasFallbackCount metric)")
+
+
 ALL_RULES = (
     ImportTimeJnpRule,
     SemaphoreReleaseRule,
@@ -548,6 +585,7 @@ ALL_RULES = (
     SyncUnderRuntimeLockRule,
     ConfRegistrySyncRule,
     MetricsKeySyncRule,
+    PallasKernelTierRule,
 )
 
 

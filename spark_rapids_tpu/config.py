@@ -827,6 +827,55 @@ SENTINEL_MAD_THRESHOLD = conf_float(
     "a guarded key regresses when value > median + threshold * "
     "max(MAD, 25% of median, key floor).  Larger values tolerate more "
     "run-to-run noise before alerting.")
+PALLAS_STRINGS_ENABLED = conf_bool(
+    "spark.rapids.sql.tpu.pallas.strings.enabled", True,
+    "Kernel-tier gate for the Pallas string contains/LIKE scan "
+    "(kernels.pallas_strings): one fused pass over the byte buffer "
+    "replacing the shifted-gather + searchsorted XLA formulation.  "
+    "Engages on a real TPU backend only (or under pallas.interpret); "
+    "anywhere else the bit-identical XLA fallback runs and "
+    "pallasFallbackCount increments.  The deprecated "
+    "SPARK_RAPIDS_PALLAS_STRINGS env var (0/false=off, interp=interpret) "
+    "is honored for one release when this conf is not explicitly set.")
+PALLAS_GATHER_SCATTER_ENABLED = conf_bool(
+    "spark.rapids.sql.tpu.pallas.gatherScatter.enabled", True,
+    "Kernel-tier gate for the segmented k-way gather/scatter Pallas "
+    "kernel: one pass per output block walking the per-input segment "
+    "table replaces the k drop-mode scatter chain inside concat_kway / "
+    "gather_segments_kway (rows and bytes, honoring the live-bytes "
+    "window so take_head-truncated inputs cannot leak stale tail "
+    "bytes).  TPU-only with automatic bit-identical XLA fallback; "
+    "unsupported element dtypes always take the fallback silently.")
+PALLAS_JOIN_PROBE_ENABLED = conf_bool(
+    "spark.rapids.sql.tpu.pallas.joinProbe.enabled", True,
+    "Kernel-tier gate for the hash-join probe Pallas kernel: when the "
+    "sorted build-side arrays fit pallas.vmemBudgetBytes, one fused "
+    "kernel performs both searchsorted passes, candidate expansion and "
+    "the exact-match word verify of join_pairs_static, emitting the "
+    "same capacity-bucketed pair buffers (hash_join_static and the "
+    "mesh-fused pipeline consume it unchanged).  TPU-only with "
+    "automatic bit-identical XLA fallback.")
+PALLAS_STRING_HASH_ENABLED = conf_bool(
+    "spark.rapids.sql.tpu.pallas.stringHash.enabled", True,
+    "Kernel-tier gate for the string key-hash Pallas kernel: a "
+    "row-blocked Horner pass over the byte buffer with segment "
+    "boundaries from the offsets replaces the pow-table + segment-sum "
+    "XLA formulation of string_hash2 (sort/join key hashing).  "
+    "TPU-only with automatic bit-identical XLA fallback.")
+PALLAS_INTERPRET = conf_bool(
+    "spark.rapids.sql.tpu.pallas.interpret", False,
+    "Debug: run every engaged kernel-tier Pallas kernel in interpret "
+    "mode (pure XLA emulation of the kernel program) so CPU-backend "
+    "tests can pin bit-identity against the XLA fallbacks.  Orders of "
+    "magnitude slower than compiled kernels — never enable in "
+    "production.")
+PALLAS_VMEM_BUDGET = conf_bytes(
+    "spark.rapids.sql.tpu.pallas.vmemBudgetBytes", 8 << 20,
+    "VMEM residency budget shared by the kernel tier: a kernel whose "
+    "resident working set (e.g. the join probe's sorted build arrays) "
+    "exceeds this many bytes falls back to the XLA formulation and "
+    "counts into pallasFallbackCount.  Sized well under a TPU core's "
+    "~16 MB VMEM to leave room for per-block buffers.")
 
 
 def registry() -> List[ConfEntry]:

@@ -96,23 +96,39 @@ def string_hash2(v: DevVal) -> Tuple[jnp.ndarray, jnp.ndarray]:
         return h1, h2
     cap = v.capacity
     nbytes = int(v.data.shape[0])
-    rows = rows_of_positions(v.offsets, nbytes)
-    rows_c = jnp.clip(rows, 0, cap - 1)
-    ends = v.offsets[rows_c + 1].astype(jnp.int32)
-    pos = jnp.arange(nbytes, dtype=jnp.int32)
-    in_data = pos < v.offsets[-1].astype(jnp.int32)
-    exp = jnp.clip(ends - 1 - pos, 0, nbytes).astype(jnp.int32)
-    byte = jnp.where(in_data, v.data, 0).astype(jnp.uint32)
-    out = []
-    for base in _HASH_BASES:
-        pows = _pow_table(base, nbytes)
-        contrib = byte * pows[exp]
-        h = jax.ops.segment_sum(jnp.where(in_data, contrib, 0), rows_c,
-                                num_segments=cap, indices_are_sorted=True)
-        # Mix in length so "" vs padding rows differ and lengths disambiguate.
-        h = h + string_lengths(v).astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
-        out.append(h.astype(jnp.uint32))
-    return out[0], out[1]
+
+    def xla():
+        rows = rows_of_positions(v.offsets, nbytes)
+        rows_c = jnp.clip(rows, 0, cap - 1)
+        ends = v.offsets[rows_c + 1].astype(jnp.int32)
+        pos = jnp.arange(nbytes, dtype=jnp.int32)
+        in_data = pos < v.offsets[-1].astype(jnp.int32)
+        exp = jnp.clip(ends - 1 - pos, 0, nbytes).astype(jnp.int32)
+        byte = jnp.where(in_data, v.data, 0).astype(jnp.uint32)
+        out = []
+        for base in _HASH_BASES:
+            pows = _pow_table(base, nbytes)
+            contrib = byte * pows[exp]
+            h = jax.ops.segment_sum(jnp.where(in_data, contrib, 0), rows_c,
+                                    num_segments=cap,
+                                    indices_are_sorted=True)
+            # Mix in length so "" vs padding rows differ and lengths
+            # disambiguate.
+            h = h + string_lengths(v).astype(jnp.uint32) * \
+                jnp.uint32(0x9E3779B9)
+            out.append(h.astype(jnp.uint32))
+        return out[0], out[1]
+
+    if nbytes < 1 or cap < 1:
+        return xla()
+    # kernel tier: Horner over each row's byte window (bit-identical —
+    # uint32 arithmetic is exact mod 2^32 in any association)
+    from spark_rapids_tpu.kernels import pallas_tier as PT
+    return PT.run(
+        "stringHash",
+        lambda interpret: PT.string_hash_rows(
+            v.data, v.offsets, cap, _HASH_BASES, interpret=interpret),
+        xla, resident_bytes=nbytes + 4 * (cap + 1))
 
 
 def hash_literal2(s: str) -> Tuple[int, int]:
@@ -183,25 +199,29 @@ def _find_matches(v: DevVal, needle: bytes):
 
 def _rows_with_match(v: DevVal, needle: bytes):
     cap = v.capacity
-    if len(needle) > 0:
-        # Pallas one-pass scan on real TPU backends (the reference's
-        # dedicated contains kernel role); XLA formulation everywhere
-        # else and as the fallback if the kernel fails to lower.
-        from spark_rapids_tpu.kernels import pallas_strings as PS
-        if PS.use_pallas_strings():
-            try:
-                return PS.rows_with_match(
-                    v.data, v.offsets, v.validity, cap, needle)
-            except Exception:
-                pass
-    match = _find_matches(v, needle)
-    nbytes = int(v.data.shape[0])
-    rows = jnp.clip(rows_of_positions(v.offsets, nbytes), 0, cap - 1)
-    counts = jax.ops.segment_sum(match.astype(jnp.int32), rows, num_segments=cap, indices_are_sorted=True)
-    has = counts > 0
+
+    def xla():
+        match = _find_matches(v, needle)
+        nbytes = int(v.data.shape[0])
+        rows = jnp.clip(rows_of_positions(v.offsets, nbytes), 0, cap - 1)
+        counts = jax.ops.segment_sum(match.astype(jnp.int32), rows,
+                                     num_segments=cap,
+                                     indices_are_sorted=True)
+        return counts > 0
+
     if len(needle) == 0:
-        has = jnp.ones(cap, dtype=jnp.bool_)
-    return has
+        return jnp.ones(cap, dtype=jnp.bool_)
+    # Pallas one-pass scan through the kernel tier (the reference's
+    # dedicated contains kernel role): conf-gated, TPU-or-interpret
+    # backend predicate, XLA formulation as the automatic fallback.
+    from spark_rapids_tpu.kernels import pallas_strings as PS
+    from spark_rapids_tpu.kernels import pallas_tier as PT
+    return PT.run(
+        "strings",
+        lambda interpret: PS.rows_with_match(
+            v.data, v.offsets, v.validity, cap, needle,
+            interpret=interpret),
+        xla)
 
 
 def _literal_needle(expr: Expression) -> Optional[str]:

@@ -135,6 +135,66 @@ def _exact_eq(a_vals: List[DevVal], a_idx, b_vals: List[DevVal], b_idx,
     return eq
 
 
+def _exact_words(vals: List[DevVal], code_over: Optional[list] = None):
+    """Pre-encoded u32 word matrix + combined validity for one side's key
+    columns: ``(words u32[W, cap], valid bool[cap])``.
+
+    Word-for-word the comparisons :func:`_exact_eq` performs — aligned
+    codes (bit-preserving int32->u32 cast), string length + dual hashes +
+    64-byte prefix words, :func:`_encode_fixed_words` for fixed types —
+    so ``valid[a] & valid[b] & AND_w(words_a[w, a] == words_b[w, b])``
+    equals ``_exact_eq`` at any index pair.  This is the layout the
+    kernel tier's join-probe kernel keeps VMEM-resident."""
+    cap = int(vals[0].validity.shape[0])
+    valid = jnp.ones(cap, dtype=jnp.bool_)
+    words: List[jnp.ndarray] = []
+    for ki, v in enumerate(vals):
+        valid = valid & v.validity
+        over = code_over[ki] if code_over is not None else None
+        if over is not None:
+            words.append(over.astype(jnp.uint32))
+        elif v.dtype.is_string:
+            from spark_rapids_tpu.exprs.strings import (
+                string_hash2, string_lengths,
+            )
+            from spark_rapids_tpu.kernels.sortkeys import (
+                DEFAULT_STRING_PREFIX_BYTES, string_prefix_words,
+            )
+            s1, s2 = string_hash2(v)
+            words += [string_lengths(v).astype(jnp.uint32), s1, s2]
+            words += string_prefix_words(v, DEFAULT_STRING_PREFIX_BYTES)
+        else:
+            from spark_rapids_tpu.kernels.sortkeys import \
+                _encode_fixed_words
+            words += _encode_fixed_words(v)
+    return jnp.stack(words), valid
+
+
+def _exact_word_count(vals: List[DevVal],
+                      code_over: Optional[list] = None) -> int:
+    """Static W of :func:`_exact_words` (for VMEM budgeting before any
+    array is built)."""
+    from spark_rapids_tpu.kernels.sortkeys import (
+        DEFAULT_STRING_PREFIX_BYTES,
+    )
+    n = 0
+    for ki, v in enumerate(vals):
+        over = code_over[ki] if code_over is not None else None
+        if over is not None:
+            n += 1
+        elif v.dtype.is_string:
+            n += 3 + (DEFAULT_STRING_PREFIX_BYTES + 3) // 4
+        elif v.dtype in (T.LONG, T.TIMESTAMP):
+            n += 2
+        elif v.dtype == T.DOUBLE:
+            # backend-dependent: 2 bitcast words on real-f64 hosts, 3
+            # float-float words on TPU (_encode_double_words)
+            n += 3 if jax.default_backend() == "tpu" else 2
+        else:
+            n += 1
+    return n
+
+
 #: Entry-pair table guard for :func:`align_dict_codes`: alignment builds
 #: an [nd_a, nd_b] boolean content-equality grid; past this many cells
 #: the memory/FLOP cost beats rehashing content through the codes, so
@@ -371,24 +431,46 @@ def join_pairs_static(left_keys: List[DevVal], left_num_rows,
     sentinel = ~jnp.uint32(0)
     r_h1 = jnp.where(r_live & r_ok, r_h1, sentinel)
     perm, r_sorted = _build_sort(r_h1, r_h2)
-    lo, counts, total = _phase1(l_h1, l_ok, l_live, r_sorted,
-                                right_num_rows)
-    overflow = total > pair_cap
-    total_c = jnp.minimum(total, pair_cap)
 
     code_pairs = [None if a is None else (a, b)
                   for a, b in zip(l_over, r_over)] if any_over else None
-    cum = jnp.cumsum(counts)
-    starts = cum - counts
-    k = jnp.arange(pair_cap, dtype=jnp.int32)
-    probe_row = jnp.searchsorted(cum, k, side="right").astype(jnp.int32)
-    probe_row = jnp.clip(probe_row, 0, l_cap - 1)
-    ordinal = (k - starts[probe_row]).astype(jnp.int32)
-    build_pos = jnp.clip(lo[probe_row] + ordinal, 0, r_cap - 1)
-    build_row = perm[build_pos]
-    in_range = k < total_c
-    match = in_range & _exact_eq(left_keys, probe_row, right_keys,
-                                 build_row, code_pairs)
+
+    def xla_candidates():
+        lo, counts, total = _phase1(l_h1, l_ok, l_live, r_sorted,
+                                    right_num_rows)
+        total_c = jnp.minimum(total, pair_cap)
+        cum = jnp.cumsum(counts)
+        starts = cum - counts
+        k = jnp.arange(pair_cap, dtype=jnp.int32)
+        probe_row = jnp.searchsorted(cum, k, side="right").astype(jnp.int32)
+        probe_row = jnp.clip(probe_row, 0, l_cap - 1)
+        ordinal = (k - starts[probe_row]).astype(jnp.int32)
+        build_pos = jnp.clip(lo[probe_row] + ordinal, 0, r_cap - 1)
+        build_row = perm[build_pos]
+        in_range = k < total_c
+        match = in_range & _exact_eq(left_keys, probe_row, right_keys,
+                                     build_row, code_pairs)
+        return probe_row, build_row, match, total
+
+    def pallas_candidates(interpret):
+        a_words, a_valid = _exact_words(left_keys,
+                                        l_over if any_over else None)
+        b_words, b_valid = _exact_words(right_keys,
+                                        r_over if any_over else None)
+        from spark_rapids_tpu.kernels import pallas_tier as PT
+        return PT.probe_join(l_h1, l_ok & l_live, r_sorted, perm,
+                             a_words, a_valid, b_words, b_valid,
+                             pair_cap, interpret=interpret)
+
+    # VMEM residency: the sorted build hashes, the permutation, the build
+    # word matrix and validity must all stay resident for the fused probe
+    from spark_rapids_tpu.kernels import pallas_tier as PT
+    n_words = _exact_word_count(right_keys, r_over if any_over else None)
+    resident = r_cap * (4 + 4 + 4 * n_words + 4)
+    probe_row, build_row, match, total = PT.run(
+        "joinProbe", pallas_candidates, xla_candidates,
+        resident_bytes=resident)
+    overflow = total > pair_cap
     order = jnp.argsort(jnp.where(match, 0, 1), stable=True)
     n_pairs = jnp.sum(match).astype(jnp.int32)
     l_idx = probe_row[order].astype(jnp.int32)

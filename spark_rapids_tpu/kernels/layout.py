@@ -204,6 +204,46 @@ def take_head(batch: ColumnBatch, limit) -> ColumnBatch:
     return ColumnBatch(batch.schema, batch.columns, n, batch.capacity)
 
 
+def _pack_kway(vals_list, los, his, out_cap: int):
+    """K-way segment pack: input j's window ``[los[j], his[j])`` lands at
+    the running output offset ``sum(his[:j] - los[:j])``; zeros elsewhere.
+
+    This is THE scatter shape shared by every k-way assembly loop below
+    (concat rows/bytes, split segments rows/bytes, dict code/byte
+    merges): each value scatters once, rows outside the window target
+    genuinely unique out-of-bounds slots (``out_cap + i``) so
+    ``mode="drop"`` discards them while the ``unique_indices`` promise
+    stays true and XLA emits a plain scatter.  The kernel tier's
+    ``gatherScatter`` Pallas pack replaces the whole chain with one pass
+    per output block when engaged (bit-identical; unsupported dtypes and
+    degenerate shapes always take the XLA chain)."""
+    los = [jnp.asarray(lo, jnp.int32) for lo in los]
+    his = [jnp.asarray(hi, jnp.int32) for hi in his]
+
+    def xla():
+        out = jnp.zeros(out_cap, dtype=vals_list[0].dtype)
+        off = jnp.asarray(0, jnp.int32)
+        for vals, lo, hi in zip(vals_list, los, his):
+            iota = jnp.arange(int(vals.shape[0]), dtype=jnp.int32)
+            rel = iota - lo
+            in_seg = (rel >= 0) & (iota < hi)
+            tgt = jnp.where(in_seg, off + rel, out_cap + iota)
+            out = out.at[tgt].set(vals, mode="drop", unique_indices=True)
+            off = off + (hi - lo)
+        return out
+
+    from spark_rapids_tpu.kernels import pallas_tier as PT
+    if out_cap < 1 or not PT.pack_supported(vals_list) or \
+            any(int(v.shape[0]) < 1 for v in vals_list):
+        return xla()
+    resident = sum(int(v.shape[0]) * v.dtype.itemsize for v in vals_list)
+    return PT.run(
+        "gatherScatter",
+        lambda interpret: PT.pack_segments(vals_list, los, his, out_cap,
+                                           interpret=interpret),
+        xla, resident_bytes=resident)
+
+
 def concat_kway(batches: Sequence[ColumnBatch], out_capacity: int,
                 out_byte_caps: Optional[Sequence[int]] = None) -> ColumnBatch:
     """Concatenate k batches (same schema) into ONE output allocation.
@@ -231,57 +271,39 @@ def concat_kway(batches: Sequence[ColumnBatch], out_capacity: int,
     for b in batches[1:]:
         assert b.schema == schema, f"{b.schema} != {schema}"
     ns = [b.num_rows for b in batches]
-    row_offs = []
     acc = jnp.asarray(0, jnp.int32)
     for n in ns:
-        row_offs.append(acc)
         acc = acc + n
     total = acc.astype(jnp.int32)
+    zeros_lo = [jnp.asarray(0, jnp.int32)] * len(batches)
 
-    def scatter_rows(init, values_per_batch):
-        out = init
-        for j, (b, vals) in enumerate(zip(batches, values_per_batch)):
-            iota = jnp.arange(b.capacity, dtype=jnp.int32)
-            tgt = jnp.where(iota < ns[j], row_offs[j] + iota,
-                            out_capacity + iota)
-            out = out.at[tgt].set(vals, mode="drop", unique_indices=True)
-        return out
+    def pack_rows(values_per_batch):
+        return _pack_kway(values_per_batch, zeros_lo, ns, out_capacity)
 
     cols = []
     str_i = 0
     for ci, f in enumerate(schema.fields):
         parts = [b.columns[ci] for b in batches]
-        validity = scatter_rows(jnp.zeros(out_capacity, dtype=jnp.bool_),
-                                [c.validity for c in parts])
+        validity = pack_rows([c.validity for c in parts])
         if parts[0].is_varlen:
             bcap = (out_byte_caps[str_i] if out_byte_caps is not None
                     else sum(int(c.data.shape[0]) for c in parts))
             str_i += 1
-            lens = scatter_rows(jnp.zeros(out_capacity, dtype=jnp.int32),
-                                [_string_lengths(c) for c in parts])
+            lens = pack_rows([_string_lengths(c) for c in parts])
             new_offsets = jnp.concatenate([
                 jnp.zeros(1, dtype=jnp.int32),
                 jnp.cumsum(lens).astype(jnp.int32),
             ])
-            data = jnp.zeros(bcap, dtype=parts[0].data.dtype)
-            byte_off = jnp.asarray(0, jnp.int32)
-            for c, n in zip(parts, ns):
-                # LIVE bytes only (offsets[num_rows], not offsets[-1]):
-                # take_head truncates num_rows without repacking, so dead
-                # rows keep growing offsets — their bytes must neither
-                # advance the cursor nor overwrite the next input's region
-                nbytes_j = c.offsets[n]
-                biota = jnp.arange(int(c.data.shape[0]), dtype=jnp.int32)
-                tgt = jnp.where(biota < nbytes_j, byte_off + biota,
-                                bcap + biota)
-                data = data.at[tgt].set(c.data, mode="drop",
-                                        unique_indices=True)
-                byte_off = byte_off + nbytes_j
+            # LIVE bytes only (offsets[num_rows], not offsets[-1]):
+            # take_head truncates num_rows without repacking, so dead
+            # rows keep growing offsets — their bytes must neither
+            # advance the cursor nor overwrite the next input's region
+            data = _pack_kway([c.data for c in parts], zeros_lo,
+                              [c.offsets[n] for c, n in zip(parts, ns)],
+                              bcap)
             cols.append(DeviceColumn(f.dtype, data, validity, new_offsets))
         else:
-            data = scatter_rows(
-                jnp.zeros(out_capacity, dtype=parts[0].data.dtype),
-                [c.data for c in parts])
+            data = pack_rows([c.data for c in parts])
             cols.append(DeviceColumn(f.dtype, data, validity, None))
     return ColumnBatch(schema, cols, total, out_capacity)
 
@@ -359,22 +381,14 @@ def gather_segments_kway(batches: Sequence[ColumnBatch], starts, counts,
         assert b.schema == schema, f"{b.schema} != {schema}"
     starts = [jnp.asarray(s, jnp.int32) for s in starts]
     counts = [jnp.asarray(c, jnp.int32) for c in counts]
-    row_offs = []
+    seg_his = [s + c for s, c in zip(starts, counts)]
     acc = jnp.asarray(0, jnp.int32)
     for c in counts:
-        row_offs.append(acc)
         acc = acc + c
     total = acc.astype(jnp.int32)
 
-    def scatter_segments(init, values_per_batch):
-        out = init
-        for j, (b, vals) in enumerate(zip(batches, values_per_batch)):
-            iota = jnp.arange(b.capacity, dtype=jnp.int32)
-            rel = iota - starts[j]
-            in_seg = (rel >= 0) & (rel < counts[j])
-            tgt = jnp.where(in_seg, row_offs[j] + rel, out_capacity + iota)
-            out = out.at[tgt].set(vals, mode="drop", unique_indices=True)
-        return out
+    def pack_segments(values_per_batch):
+        return _pack_kway(values_per_batch, starts, seg_his, out_capacity)
 
     cols = []
     str_i = 0
@@ -386,8 +400,7 @@ def gather_segments_kway(batches: Sequence[ColumnBatch], starts, counts,
             # so materialize the encoded ones and take the plain path
             parts = [dict_decode_column(c) if c.codes is not None else c
                      for c in parts]
-        validity = scatter_segments(jnp.zeros(out_capacity, dtype=jnp.bool_),
-                                    [c.validity for c in parts])
+        validity = pack_segments([c.validity for c in parts])
         if keep_encoded and all(c.codes is not None for c in parts):
             mat_cap = (out_byte_caps[str_i] if out_byte_caps is not None
                        else sum((c.mat_byte_cap or int(c.data.shape[0]))
@@ -401,8 +414,7 @@ def gather_segments_kway(batches: Sequence[ColumnBatch], starts, counts,
                 ent_lens_parts.append(
                     (c.offsets[1:] - c.offsets[:-1]).astype(jnp.int32))
                 entry_base += int(c.offsets.shape[0]) - 1
-            codes = scatter_segments(
-                jnp.zeros(out_capacity, dtype=jnp.int32), shifted_codes)
+            codes = pack_segments(shifted_codes)
             # merged dictionary: entry lens concatenate at static bases, so
             # one cumsum yields offsets whose per-input byte base equals the
             # dynamic packing cursor below (padded entries have zero lens)
@@ -411,45 +423,30 @@ def gather_segments_kway(batches: Sequence[ColumnBatch], starts, counts,
                 jnp.cumsum(jnp.concatenate(ent_lens_parts)).astype(jnp.int32),
             ])
             dcap = sum(int(c.data.shape[0]) for c in parts)
-            data = jnp.zeros(dcap, dtype=parts[0].data.dtype)
-            byte_off = jnp.asarray(0, jnp.int32)
-            for c in parts:
-                nbytes_j = c.offsets[int(c.offsets.shape[0]) - 1]
-                biota = jnp.arange(int(c.data.shape[0]), dtype=jnp.int32)
-                tgt = jnp.where(biota < nbytes_j, byte_off + biota,
-                                dcap + biota)
-                data = data.at[tgt].set(c.data, mode="drop",
-                                        unique_indices=True)
-                byte_off = byte_off + nbytes_j
+            data = _pack_kway(
+                [c.data for c in parts],
+                [jnp.asarray(0, jnp.int32)] * len(parts),
+                [c.offsets[int(c.offsets.shape[0]) - 1] for c in parts],
+                dcap)
             cols.append(DeviceColumn(f.dtype, data, validity, merged_offsets,
                                      codes, mat_cap))
         elif parts[0].is_varlen:
             bcap = (out_byte_caps[str_i] if out_byte_caps is not None
                     else sum(int(c.data.shape[0]) for c in parts))
             str_i += 1
-            lens = scatter_segments(jnp.zeros(out_capacity, dtype=jnp.int32),
-                                    [_string_lengths(c) for c in parts])
+            lens = pack_segments([_string_lengths(c) for c in parts])
             new_offsets = jnp.concatenate([
                 jnp.zeros(1, dtype=jnp.int32),
                 jnp.cumsum(lens).astype(jnp.int32),
             ])
-            data = jnp.zeros(bcap, dtype=parts[0].data.dtype)
-            byte_off = jnp.asarray(0, jnp.int32)
-            for c, s, n in zip(parts, starts, counts):
-                lo = c.offsets[s]
-                hi = c.offsets[s + n]
-                biota = jnp.arange(int(c.data.shape[0]), dtype=jnp.int32)
-                brel = biota - lo
-                in_seg = (brel >= 0) & (biota < hi)
-                tgt = jnp.where(in_seg, byte_off + brel, bcap + biota)
-                data = data.at[tgt].set(c.data, mode="drop",
-                                        unique_indices=True)
-                byte_off = byte_off + (hi - lo)
+            data = _pack_kway(
+                [c.data for c in parts],
+                [c.offsets[s] for c, s in zip(parts, starts)],
+                [c.offsets[s + n] for c, s, n in zip(parts, starts, counts)],
+                bcap)
             cols.append(DeviceColumn(f.dtype, data, validity, new_offsets))
         else:
-            data = scatter_segments(
-                jnp.zeros(out_capacity, dtype=parts[0].data.dtype),
-                [c.data for c in parts])
+            data = pack_segments([c.data for c in parts])
             cols.append(DeviceColumn(f.dtype, data, validity, None))
     return ColumnBatch(schema, cols, total, out_capacity)
 

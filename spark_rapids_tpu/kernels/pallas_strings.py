@@ -24,14 +24,15 @@ blocks cannot overlap, so the halo is expressed as a duplicate input)
 and emits BLOCK match flags via L static slices of the concatenation.
 
 Used automatically for Contains/Like-contains when the backend is a real
-TPU (exprs/strings.py wires it behind ``use_pallas_strings()``); the XLA
+TPU: exprs/strings.py routes through the kernel tier's ``strings`` entry
+(kernels.pallas_tier — conf gate ``spark.rapids.sql.tpu.pallas.strings.
+enabled``, interpret mode under ``pallas.interpret``); the XLA
 formulation remains both the CPU-backend path and the fallback.
 """
 
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -43,25 +44,21 @@ BLOCK = 16384  # bytes of match output per program (128-aligned)
 
 
 def use_pallas_strings() -> bool:
-    """Pallas kernels only target a real TPU backend; anything else
-    (CPU tests, interpret-mode experiments) uses the XLA formulation.
-    Env ``SPARK_RAPIDS_PALLAS_STRINGS``: 0=off, 1=TPU-only (default),
-    interp=force interpret mode (CPU correctness tests)."""
-    flag = os.environ.get("SPARK_RAPIDS_PALLAS_STRINGS", "1")
-    if flag in ("0", "false"):
-        return False
-    if flag == "interp":
-        return True
-    try:
-        # strictly tpu: other accelerator backends (gpu, tunneled plugins)
-        # must NOT take the Pallas TPU lowering
-        return jax.default_backend() == "tpu"
-    except Exception:
-        return False
+    """Deprecated: the decision now lives in the kernel tier
+    (``spark.rapids.sql.tpu.pallas.strings.enabled`` + backend predicate;
+    the env var survives one release as an alias).  Kept for callers that
+    only need the boolean."""
+    from spark_rapids_tpu.kernels import pallas_tier
+    return pallas_tier.decide("strings").engaged
 
 
 def _interpret() -> bool:
-    return os.environ.get("SPARK_RAPIDS_PALLAS_STRINGS") == "interp"
+    """Deprecated alias resolution (tier ``pallas.interpret`` conf or the
+    old env value) — the default for direct :func:`rows_with_match`
+    callers; production traffic passes ``interpret`` explicitly through
+    the tier."""
+    from spark_rapids_tpu.kernels import pallas_tier
+    return pallas_tier.decide("strings").interpret
 
 
 def _match_kernel(cur_ref, nxt_ref, scur_ref, snxt_ref, out_ref, *,
@@ -79,8 +76,9 @@ def _match_kernel(cur_ref, nxt_ref, scur_ref, snxt_ref, out_ref, *,
     out_ref[...] = m.astype(jnp.int32)
 
 
-@instrumented_jit(label="pallas:contains", static_argnames=("needle",))
-def contains_match(data, offsets, needle: tuple):
+@instrumented_jit(label="pallas:contains",
+                  static_argnames=("needle", "interpret"))
+def contains_match(data, offsets, needle: tuple, interpret: bool = False):
     """int32[nbytes_padded]: 1 where ``needle`` (tuple of byte values)
     matches starting at this byte position without crossing a row
     boundary.  ``data`` u8[nbytes], ``offsets`` int32[cap+1]."""
@@ -107,7 +105,7 @@ def contains_match(data, offsets, needle: tuple):
         in_specs=[spec_cur, spec_nxt, spec_cur, spec_nxt],
         out_specs=pl.BlockSpec((BLOCK,), lambda i: (i,)),
         out_shape=jax.ShapeDtypeStruct((padded,), jnp.int32),
-        interpret=_interpret(),
+        interpret=interpret,
     )(data, data, starts, starts)
     # the last block's halo duplicates itself (there is no next block);
     # kill any match that would need bytes past the live end — also
@@ -116,12 +114,16 @@ def contains_match(data, offsets, needle: tuple):
     return out * (pos + len(needle) <= offsets[-1]).astype(jnp.int32)
 
 
-def rows_with_match(data, offsets, validity, cap: int, needle: bytes):
+def rows_with_match(data, offsets, validity, cap: int, needle: bytes,
+                    interpret: bool = None):
     """bool[cap]: row contains ``needle`` — the Pallas-backed analogue of
-    exprs.strings._rows_with_match."""
+    exprs.strings._rows_with_match.  ``interpret`` defaults to the tier
+    decision (conf / deprecated env alias) for direct callers."""
     if len(needle) == 0:
         return jnp.ones(cap, dtype=jnp.bool_)
-    match = contains_match(data, offsets, tuple(needle))
+    if interpret is None:
+        interpret = _interpret()
+    match = contains_match(data, offsets, tuple(needle), interpret)
     # exclusive cumsum -> per-row match counts via two O(cap) gathers
     c = jnp.concatenate([jnp.zeros(1, jnp.int32),
                          jnp.cumsum(match).astype(jnp.int32)])

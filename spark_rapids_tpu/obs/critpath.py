@@ -35,7 +35,7 @@ from .events import SPAN, field
 #: is not a site — it is the remainder.
 SITE_PRIORITY: Tuple[str, ...] = (
     "device", "h2d", "d2h", "spill", "unspill", "exchange", "mesh",
-    "scan", "io", "dispatch", "retry", "fault",
+    "scan", "io", "dispatch", "pallas", "retry", "fault",
 )
 
 WAIT = "wait"

@@ -61,11 +61,13 @@ def make_distributed_agg_step(mesh: Mesh, cap: int):
         gk, gs, ng = _local_sum_by_key(k, v, val, nr, out_cap)
         return gk[None], gs[None], ng[None]
 
+    from spark_rapids_tpu.parallel.mesh_shuffle import shard_map_kwargs
     local_agg_fn = jax.jit(shard_map(
         local_agg, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None),
                   P(DATA_AXIS, None), P(DATA_AXIS)),
-        out_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None), P(DATA_AXIS))))
+        out_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None), P(DATA_AXIS)),
+        **shard_map_kwargs()))
 
     def step(keys, values, validity, num_rows):
         pids = (jnp.abs(keys) % n).astype(jnp.int32)

@@ -79,6 +79,29 @@ def make_mesh(n_devices: Optional[int] = None) -> Mesh:
     return Mesh(np.array(devs[:n]), (DATA_AXIS,))
 
 
+def shard_map_kwargs() -> dict:
+    """kwargs disabling shard_map's static replication checker.
+
+    The checker has no rule for ``pallas_call`` (kernels/pallas_tier.py
+    kernels traced inside mesh programs raise NotImplementedError) and
+    mis-tracks ``lax.scan`` carries mixing a replicated build side with
+    sharded probe rows.  It is advisory only — correctness never depends
+    on it; output specs are verified structurally by plan_verify.  The
+    kwarg is probed by name: jax 0.4.x calls it ``check_rep``, newer
+    releases renamed it ``check_vma``.
+    """
+    import inspect
+    try:
+        from jax import shard_map  # jax >= 0.6 top-level export
+    except ImportError:  # jax 0.4.x keeps it in experimental
+        from jax.experimental.shard_map import shard_map
+    params = inspect.signature(shard_map).parameters
+    for kw in ("check_rep", "check_vma"):
+        if kw in params:
+            return {kw: False}
+    return {}
+
+
 def _local_partition_buckets(data_cols, validity_cols, num_rows, pids,
                              n: int, cap: int):
     """Split local rows into n destination buckets of fixed capacity cap.
@@ -168,7 +191,7 @@ def make_exchange_fn(mesh: Mesh, n_cols: int, cap: int):
                  [P(DATA_AXIS, None)] * n_cols,
                  P(DATA_AXIS))
     return jax.jit(shard_map(spmd, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs))
+                             out_specs=out_specs, **shard_map_kwargs()))
 
 
 # --------------------------------------------------------------------------
@@ -441,7 +464,7 @@ def _make_mesh_payload_fn(mesh: Mesh, sig, cap: int, ecaps: tuple,
         out_specs += [P(DATA_AXIS, None)] * k
     out_specs.append(P(DATA_AXIS))
     return jax.jit(shard_map(spmd, mesh=mesh, in_specs=(in_specs,),
-                             out_specs=out_specs))
+                             out_specs=out_specs, **shard_map_kwargs()))
 
 
 # Compiled exchange programs, keyed by (mesh, schema signature, capacities).

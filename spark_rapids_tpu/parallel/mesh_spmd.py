@@ -372,21 +372,13 @@ def run_mesh_stage(root, ctx, variant: str,
             from jax import shard_map  # jax >= 0.6 top-level export
         except ImportError:  # jax 0.4.x keeps it in experimental
             from jax.experimental.shard_map import shard_map
-        sm_kw = {}
-        if replicated:
-            # the static replication checker mis-tracks lax.scan carries
-            # that mix a replicated build side with sharded probe rows
-            # (jax#scan-carry replication bug); correctness does not
-            # depend on it — specs are verified by plan_verify instead
-            import inspect
-            params = inspect.signature(shard_map).parameters
-            for kw in ("check_rep", "check_vma"):
-                if kw in params:
-                    sm_kw[kw] = False
-                    break
+        # replication checker off unconditionally (not just for the
+        # replicated-build fused join): pallas-tier kernels traced inside
+        # the stage body have no replication rule — see shard_map_kwargs
+        from spark_rapids_tpu.parallel.mesh_shuffle import shard_map_kwargs
         program = instrumented_jit(
             shard_map(body, mesh=mesh, in_specs=(tuple(in_specs),),
-                      out_specs=P(DATA_AXIS), **sm_kw),
+                      out_specs=P(DATA_AXIS), **shard_map_kwargs()),
             label=f"meshStage:{root.name}")
         cache[key] = program
 

@@ -225,6 +225,10 @@ class TpuSparkSession:
                          OBS_TELEMETRY_INTERVAL_MS.get(self.conf),
                          OBS_TELEMETRY_MAX_INTERVALS.get(self.conf))
         self._register_telemetry_gauges()
+        # the Pallas kernel tier consults this session's conf for its
+        # per-kernel gates at trace time (kernels.pallas_tier)
+        from spark_rapids_tpu.kernels import pallas_tier
+        pallas_tier.configure(self.conf)
         phys = self.plan_physical(plan)
         if self.conf.test_enforce_tpu:
             _assert_on_tpu(phys)
@@ -269,6 +273,7 @@ class TpuSparkSession:
         t_query0 = time.monotonic_ns()
         before = CR.snapshot()
         fm_before = FM.snapshot()
+        pt_before = pallas_tier.fallback_count()
         cat_before = dict(self.runtime.catalog.metrics) \
             if self.runtime is not None else {}
         try:
@@ -302,6 +307,11 @@ class TpuSparkSession:
         # compile/dispatch economics for THIS query (process-wide counters
         # snapshotted around the collect; compiledShapes is the cumulative
         # compiled-executable cardinality the bucket policy bounds)
+        # kernel-tier economics: XLA fallbacks the Pallas tier took at
+        # trace time during this query (backend/budget/lowering failure;
+        # cached executables trace nothing and count nothing)
+        frame.last_metrics["pallasFallbackCount"] = \
+            pallas_tier.fallback_count() - pt_before
         frame.last_metrics["compileCount"] = d["compiles"]
         frame.last_metrics["compileWallNs"] = d["compile_wall_ns"]
         frame.last_metrics["dispatchCount"] = d["dispatches"]

@@ -330,6 +330,38 @@ def test_r8_fires_when_doc_missing(tmp_path):
     assert len(out) == 1 and "missing" in out[0].message
 
 
+# -- R9: pallas kernel tier ---------------------------------------------------
+
+def test_r9_fires_outside_tier_entry_points():
+    out = lint(R.PallasKernelTierRule(), """\
+        from jax.experimental import pallas as pl
+        def rogue_kernel(x):
+            return pl.pallas_call(lambda r, o: None,
+                                  out_shape=x)(x)
+        """, path="spark_rapids_tpu/exprs/strings.py")
+    assert rule_ids(out) == ["R9"]
+    assert "pallas_tier" in out[0].message
+
+
+def test_r9_quiet_in_tier_entry_points():
+    src = """\
+        from jax.experimental import pallas as pl
+        def kernel(x):
+            return pl.pallas_call(lambda r, o: None, out_shape=x)(x)
+        """
+    for allowed in ("spark_rapids_tpu/kernels/pallas_tier.py",
+                    "spark_rapids_tpu/kernels/pallas_strings.py"):
+        assert lint(R.PallasKernelTierRule(), src, path=allowed) == []
+
+
+def test_r9_quiet_on_unrelated_calls():
+    out = lint(R.PallasKernelTierRule(), """\
+        def fine(x):
+            return pallas_callback(x)  # not pallas_call
+        """, path="spark_rapids_tpu/kernels/layout.py")
+    assert out == []
+
+
 # -- suppressions and baseline mechanics --------------------------------------
 
 def test_line_suppression_silences_one_rule_only():
@@ -399,7 +431,7 @@ def test_tree_is_clean_against_baseline():
 def test_cli_rules_catalog_lists_all_rules():
     p = _run_cli("--rules")
     assert p.returncode == 0
-    for rid in ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8"):
+    for rid in ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8", "R9"):
         assert rid in p.stdout
 
 
@@ -428,7 +460,10 @@ def _make_tree(tmp_path, bad_source):
      "        with self._lock:\n"
      "            return jax.device_get(b)\n"),                         # R6
     'K = conf_int("spark.rapids.test.dead", 1, "never read")\n',        # R7
-], ids=["R1", "R2", "R3", "R4", "R5", "R6", "R7"])
+    ("from jax.experimental import pallas as pl\n"
+     "def f(x):\n"
+     "    return pl.pallas_call(g, out_shape=x)(x)\n"),                 # R9
+], ids=["R1", "R2", "R3", "R4", "R5", "R6", "R7", "R9"])
 def test_cli_rejects_injected_regression(tmp_path, bad):
     root, bl = _make_tree(tmp_path, bad)
     p = _run_cli("--check", "--root", root, "--baseline", bl)

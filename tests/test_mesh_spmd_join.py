@@ -159,6 +159,33 @@ def test_spmd_join_fused_economics():
     assert m["meshProgramDispatches"] >= 1, m
 
 
+def test_spmd_join_with_pallas_probe_kernel_parity():
+    """Mesh v2 fused join with the Pallas probe kernel engaged (interpret
+    mode on the CPU mesh): bit-identical rows, still one fused program
+    with zero shuffle syncs, and zero kernel fallbacks — the kernel tier
+    is shard_map-compatible (docs/kernels.md)."""
+    pallas_on = {
+        "spark.rapids.sql.tpu.pallas.interpret": True,
+    }
+    pallas_off = {
+        "spark.rapids.sql.tpu.pallas.strings.enabled": False,
+        "spark.rapids.sql.tpu.pallas.gatherScatter.enabled": False,
+        "spark.rapids.sql.tpu.pallas.joinProbe.enabled": False,
+        "spark.rapids.sql.tpu.pallas.stringHash.enabled": False,
+    }
+    off = tpu_session(**SPMD_CONFS, **HASH_JOIN, **pallas_off)
+    want = _rows(_join_query(off, "inner", "hash"))
+
+    s = tpu_session(**SPMD_CONFS, **HASH_JOIN, **pallas_on)
+    got = _rows(_join_query(s, "inner", "hash"))
+    assert got == want, (got[:4], want[:4])
+    m = s.last_metrics
+    assert m["meshJoinsFused"] >= 1, m
+    assert m["shuffleSyncs"] == 0, m
+    assert m["meshFallbacks"] == 0, m
+    assert m["pallasFallbackCount"] == 0, m
+
+
 def test_spmd_join_empty_shards_parity():
     """2 distinct keys over 8 shards: most shards receive zero rows and
     the per-shard static join must stay exact through them."""
